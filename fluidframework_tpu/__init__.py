@@ -1,0 +1,33 @@
+"""fluidframework_tpu — a TPU-native framework for real-time collaborative data.
+
+Provides the capabilities of Microsoft's Fluid Framework (reference:
+/root/reference, see SURVEY.md) re-designed TPU-first:
+
+- ``protocol``  — wire contract: message types, quorum consensus, summary trees
+                  (ref: server/routerlicious/packages/protocol-definitions,
+                  protocol-base).
+- ``mergetree`` — the core sequence CRDT ("merge tree"): scalar reference
+                  implementation used as the oracle for the TPU kernels
+                  (ref: packages/dds/merge-tree).
+- ``ops``       — tensor encodings and JAX/Pallas kernels for the hot paths:
+                  batched (refSeq, clientId) position resolution and
+                  segment-merge apply across thousands of documents.
+- ``dds``       — distributed data structures: SharedString, SharedMap,
+                  SharedDirectory, SharedMatrix, SharedCell, SharedCounter,
+                  consensus collections, Ink (ref: packages/dds/*).
+- ``runtime``   — container runtime: op routing, batching, pending-state
+                  replay, summarizer (ref: packages/runtime/*).
+- ``loader``    — container loading and the delta manager op pump
+                  (ref: packages/loader/container-loader).
+- ``driver``    — service adapters (ref: packages/drivers/*).
+- ``service``   — the ordering service: deli sequencer, scribe, broadcaster,
+                  scriptorium lambdas and their in-process host
+                  (ref: server/routerlicious/packages/lambdas, memory-orderer).
+- ``storage``   — content-addressed snapshot store (git analog; ref:
+                  server/gitrest, services-client GitManager).
+- ``parallel``  — device-mesh sharding for the sequencer and kernel batch
+                  (jax.sharding over docs/sequence axes).
+- ``utils``     — telemetry, tracing, config registry, small collections.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,57 @@
+"""Wire protocol: the contract shared by clients and the ordering service.
+
+Ref: server/routerlicious/packages/protocol-definitions/src/protocol.ts,
+summary.ts, consensus.ts, storage.ts and protocol-base/src/quorum.ts,
+protocol.ts (see SURVEY.md §2.7).
+"""
+
+from .messages import (
+    MessageType,
+    NackErrorType,
+    DocumentMessage,
+    SequencedDocumentMessage,
+    Nack,
+    TraceHop,
+    Signal,
+    UNASSIGNED_SEQ,
+    UNIVERSAL_SEQ,
+)
+from .summary import (
+    SummaryType,
+    SummaryBlob,
+    SummaryHandle,
+    SummaryAttachment,
+    SummaryTree,
+    SummaryObject,
+)
+from .consensus import (
+    ClientDetails,
+    SequencedClient,
+    QuorumProposal,
+    ProposalState,
+)
+from .quorum import Quorum, ProtocolOpHandler
+
+__all__ = [
+    "MessageType",
+    "NackErrorType",
+    "DocumentMessage",
+    "SequencedDocumentMessage",
+    "Nack",
+    "TraceHop",
+    "Signal",
+    "UNASSIGNED_SEQ",
+    "UNIVERSAL_SEQ",
+    "SummaryType",
+    "SummaryBlob",
+    "SummaryHandle",
+    "SummaryAttachment",
+    "SummaryTree",
+    "SummaryObject",
+    "ClientDetails",
+    "SequencedClient",
+    "QuorumProposal",
+    "ProposalState",
+    "Quorum",
+    "ProtocolOpHandler",
+]

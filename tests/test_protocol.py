@@ -1,0 +1,122 @@
+"""Protocol core tests: quorum membership, unanimous-silence proposals,
+ProtocolOpHandler snapshot round-trip.
+
+Mirrors the reference's protocol-base unit tests (quorum join/leave/propose
+semantics, SURVEY.md §2.7).
+"""
+
+from fluidframework_tpu.protocol import (
+    MessageType,
+    ProtocolOpHandler,
+    Quorum,
+    SequencedDocumentMessage,
+)
+
+
+def seqmsg(seq, msn, mtype, contents=None, client_id="A", ref_seq=0, client_seq=0):
+    return SequencedDocumentMessage(
+        client_id=client_id,
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_sequence_number=client_seq,
+        reference_sequence_number=ref_seq,
+        type=mtype,
+        contents=contents,
+    )
+
+
+def test_join_leave_membership():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A", "userId": "u1"}))
+    h.process_message(seqmsg(2, 0, MessageType.CLIENT_JOIN, {"clientId": "B", "userId": "u2"}))
+    assert set(h.quorum.members) == {"A", "B"}
+    assert h.quorum.members["A"].sequence_number == 1
+    h.process_message(seqmsg(3, 1, MessageType.CLIENT_LEAVE, "A"))
+    assert set(h.quorum.members) == {"B"}
+    assert h.sequence_number == 3
+    assert h.minimum_sequence_number == 1
+
+
+def test_proposal_accepts_when_msn_passes():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"}))
+    h.process_message(seqmsg(2, 0, MessageType.PROPOSE, {"key": "code", "value": "v2"}))
+    assert not h.quorum.has("code")  # still pending: msn hasn't passed seq 2
+    h.process_message(seqmsg(3, 2, MessageType.NOOP))
+    assert h.quorum.get("code") == "v2"
+
+
+def test_proposal_rejected_blocks_commit():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"}))
+    h.process_message(seqmsg(2, 0, MessageType.CLIENT_JOIN, {"clientId": "B"}))
+    h.process_message(seqmsg(3, 0, MessageType.PROPOSE, {"key": "k", "value": 1}, client_id="A"))
+    h.process_message(seqmsg(4, 0, MessageType.REJECT, 3, client_id="B"))
+    h.process_message(seqmsg(5, 4, MessageType.NOOP))
+    assert not h.quorum.has("k")
+    assert 3 not in h.quorum.proposals  # settled (rejected), not pending
+
+
+def test_duplicate_messages_ignored():
+    h = ProtocolOpHandler()
+    m = seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"})
+    h.process_message(m)
+    h.process_message(m)  # replay below head: no-op
+    assert len(h.quorum.members) == 1
+
+
+def test_snapshot_roundtrip():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A", "userId": "u"}))
+    h.process_message(seqmsg(2, 0, MessageType.PROPOSE, {"key": "code", "value": "v1"}))
+    h.process_message(seqmsg(3, 2, MessageType.NOOP))
+    h.process_message(seqmsg(4, 2, MessageType.PROPOSE, {"key": "pending", "value": 9}))
+
+    snap = h.snapshot()
+    h2 = ProtocolOpHandler.load(snap)
+    assert h2.sequence_number == 4
+    assert h2.minimum_sequence_number == 2
+    assert h2.quorum.get("code") == "v1"
+    assert 4 in h2.quorum.proposals  # pending proposal survives
+    # pending proposal still commits after restore
+    h2.process_message(seqmsg(5, 4, MessageType.NOOP))
+    assert h2.quorum.get("pending") == 9
+
+
+def test_snapshot_preserves_rejections():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"}))
+    h.process_message(seqmsg(2, 0, MessageType.CLIENT_JOIN, {"clientId": "B"}))
+    h.process_message(seqmsg(3, 0, MessageType.PROPOSE, {"key": "k", "value": 1}, client_id="A"))
+    h.process_message(seqmsg(4, 0, MessageType.REJECT, 3, client_id="B"))
+    # restore mid-flight: the rejection must survive or replicas diverge
+    h2 = ProtocolOpHandler.load(h.snapshot())
+    h2.process_message(seqmsg(5, 4, MessageType.NOOP))
+    assert not h2.quorum.has("k")
+
+
+def test_sequence_gap_raises():
+    import pytest
+    from fluidframework_tpu.protocol.quorum import ProtocolError
+
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"}))
+    with pytest.raises(ProtocolError):
+        h.process_message(seqmsg(5, 0, MessageType.NOOP))
+
+
+def test_malformed_reject_ignored():
+    h = ProtocolOpHandler()
+    h.process_message(seqmsg(1, 0, MessageType.CLIENT_JOIN, {"clientId": "A"}))
+    h.process_message(seqmsg(2, 0, MessageType.REJECT, None))
+    h.process_message(seqmsg(3, 0, MessageType.REJECT, {"bogus": True}))
+    assert h.sequence_number == 3
+
+
+def test_proposal_events_fire():
+    q = Quorum()
+    approved = []
+    q.on("approveProposal", lambda p: approved.append((p.key, p.value)))
+    q.add_proposal("k", "v", seq=5, local=True)
+    q.update_minimum_sequence_number(5, 6)
+    assert approved == [("k", "v")]
