@@ -1,0 +1,218 @@
+"""Deterministic merge-tree semantics tests.
+
+Each test pins one concurrency rule (mirrors the reference's directed specs:
+client.applyMsg.spec.ts, mergeTree.markRangeRemoved.spec.ts — SURVEY.md §4).
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.mergetree import MergeTreeClient
+from tests.mergetree_fixtures import FarmClient, FarmServer, assert_converged
+
+
+def make_farm(n, seed=0):
+    rng = random.Random(seed)
+    clients = [FarmClient(f"c{i}") for i in range(n)]
+    server = FarmServer(clients, rng)
+    return clients, server
+
+
+def test_basic_insert_remove_local():
+    c = MergeTreeClient("a")
+    c.insert_text_local(0, "hello world")
+    assert c.get_text() == "hello world"
+    c.remove_range_local(5, 11)
+    assert c.get_text() == "hello"
+    c.insert_text_local(5, "!")
+    assert c.get_text() == "hello!"
+
+
+def test_sequential_edits_converge():
+    (a, b), server = make_farm(2)
+    a.insert(0, "abc")
+    server.sequence_all()
+    b.insert(3, "def")
+    server.sequence_all()
+    assert a.text() == b.text() == "abcdef"
+
+
+def test_concurrent_inserts_same_position():
+    (a, b), server = make_farm(2)
+    a.insert(0, "base")
+    server.sequence_all()
+    # both insert at position 0 concurrently
+    a.insert(0, "AA")
+    b.insert(0, "BB")
+    server.sequence_all()
+    assert_converged([a, b], "concurrent same-pos insert")
+    # both fragments present, base intact
+    assert set(a.text()[:-4].replace("AA", "").replace("BB", "")) == set()
+    assert a.text().endswith("base")
+    assert "AA" in a.text() and "BB" in a.text()
+
+
+def test_own_pending_then_remote_insert():
+    (a, b), server = make_farm(2)
+    a.insert(0, "base")
+    server.sequence_all()
+    # a types two chunks locally (unacked), b inserts concurrently at 0
+    a.insert(0, "1")
+    a.insert(1, "2")  # after its own pending "1"
+    b.insert(0, "X")
+    server.sequence_all()
+    assert_converged([a, b], "pending-vs-remote")
+    assert "12" in a.text()  # a's own ordering preserved
+
+
+def test_insert_into_concurrently_removed_range():
+    (a, b), server = make_farm(2)
+    a.insert(0, "abcdef")
+    server.sequence_all()
+    # b inserts inside [1, 5) while a removes it: insert must survive
+    b.insert(3, "XY")
+    a.remove(1, 5)
+    server.sequence_all()
+    assert_converged([a, b], "insert into removed range")
+    assert "XY" in a.text()
+    assert a.text() == "aXYf"
+
+
+def test_overlapping_concurrent_removes():
+    (a, b), server = make_farm(2)
+    a.insert(0, "abcdef")
+    server.sequence_all()
+    a.remove(1, 4)  # bcd
+    b.remove(2, 5)  # cde
+    server.sequence_all()
+    assert_converged([a, b], "overlapping removes")
+    assert a.text() == "af"
+
+
+def test_remove_then_concurrent_annotate():
+    (a, b), server = make_farm(2)
+    a.insert(0, "abcdef")
+    server.sequence_all()
+    a.remove(0, 3)
+    b.annotate(0, 6, {"bold": True})
+    server.sequence_all()
+    assert_converged([a, b], "remove vs annotate")
+    assert a.text() == "def"
+
+
+def test_annotate_lww_by_seq():
+    (a, b), server = make_farm(2)
+    a.insert(0, "xyz")
+    server.sequence_all()
+    a.annotate(0, 3, {"color": "red"})
+    b.annotate(0, 3, {"color": "blue"})
+    server.sequence_all()
+    assert_converged([a, b], "annotate LWW")
+    # whichever sequenced later wins — both replicas agree on the winner
+    colors = {seg.props.get("color") for seg in a.client.tree.segments}
+    assert len(colors) == 1
+
+
+def test_annotate_delete_key():
+    (a, b), server = make_farm(2)
+    a.insert(0, "xyz")
+    a.annotate(0, 3, {"k": 1})
+    server.sequence_all()
+    b.annotate(0, 3, {"k": None})
+    server.sequence_all()
+    assert_converged([a, b], "annotate delete")
+    assert all("k" not in seg.props for seg in a.client.tree.segments)
+
+
+def test_marker_insert():
+    (a, b), server = make_farm(2)
+    a.insert(0, "para1")
+    a.submit(a.client.insert_marker_local(5, {"refType": 1}, {"type": "pg"}))
+    server.sequence_all()
+    assert a.client.get_length() == 6
+    assert_converged([a, b], "marker")
+
+
+def test_zamboni_compacts_and_preserves_text():
+    (a, b), server = make_farm(2)
+    for i in range(10):
+        a.insert(a.client.get_length(), f"w{i}")
+        server.sequence_all()
+        b.insert(0, "z")
+        server.sequence_all()
+    a.remove(0, 5)
+    server.sequence_all()
+    # noops advance refSeq → msn rises → zamboni merges/drops
+    a.insert(a.client.get_length(), ".")
+    b.insert(0, "-")
+    server.sequence_all()
+    assert_converged([a, b], "zamboni")
+    # removed-below-msn segments must be gone from both replicas
+    assert all(
+        seg.rem_seq is None or seg.rem_seq > a.client.tree.min_seq
+        for seg in a.client.tree.segments
+    )
+    # compaction merged acked runs: fewer segments than ops issued
+    assert len(a.client.tree.segments) < 24
+
+
+def test_snapshot_roundtrip_and_catchup():
+    (a, b), server = make_farm(2)
+    a.insert(0, "hello ")
+    b.insert(0, "say: ")
+    server.sequence_all()
+    a.annotate(0, 4, {"em": 1})
+    server.sequence_all()
+    snap = a.client.snapshot()
+    c = MergeTreeClient.load("c_new", snap)
+    assert c.get_text() == a.text()
+    # catch-up: new client applies subsequent sequenced ops correctly
+    b.insert(b.client.get_length(), "world")
+    raw = b.outbound[-1]
+    server.sequence_all()
+    from fluidframework_tpu.protocol import MessageType, SequencedDocumentMessage
+
+    c.apply_msg(
+        SequencedDocumentMessage(
+            client_id="c1",
+            sequence_number=server.seq,
+            minimum_sequence_number=0,
+            client_sequence_number=raw["clientSeq"],
+            reference_sequence_number=raw["refSeq"],
+            type=MessageType.OPERATION,
+            contents=raw["contents"],
+        )
+    )
+    assert c.get_text() == a.text()
+
+
+def test_snapshot_refuses_pending():
+    c = MergeTreeClient("a")
+    c.insert_text_local(0, "x")
+    with pytest.raises(RuntimeError):
+        c.snapshot()
+
+
+def test_local_reference_slides_on_remove():
+    (a, b), server = make_farm(2)
+    a.insert(0, "abcdef")
+    server.sequence_all()
+    ref = a.client.create_reference(3)  # points at 'd'
+    assert a.client.reference_position(ref) == 3
+    b.remove(2, 5)  # removes cde including ref's segment
+    server.sequence_all()
+    # ref slid to a surviving segment; position is within the doc
+    pos = a.client.reference_position(ref)
+    assert 0 <= pos <= a.client.get_length()
+
+
+def test_three_way_concurrent_edits():
+    (a, b, c), server = make_farm(3)
+    a.insert(0, "The quick brown fox")
+    server.sequence_all()
+    a.insert(19, " jumps")
+    b.remove(4, 10)  # "quick "
+    c.annotate(10, 15, {"style": "i"})
+    server.sequence_all()
+    assert_converged([a, b, c], "three-way")

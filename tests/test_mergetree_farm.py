@@ -1,0 +1,153 @@
+"""Randomized convergence farms — the merge-tree's safety net.
+
+Mirrors the reference's client.conflictFarm.spec.ts and
+client.reconnectFarm.spec.ts (SURVEY.md §4): N clients × rounds of random
+concurrent ops, sequenced in random interleavings (per-client FIFO
+preserved), asserting every client converges to identical rich text. The
+reconnect farm additionally drops unsequenced ops and resubmits
+regenerated (rebased) ops mid-stream.
+
+Seeds are fixed: any failure is reproducible and prints a per-client
+segment dump (assert_converged).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tests.mergetree_fixtures import (
+    FarmClient,
+    FarmServer,
+    assert_converged,
+    random_op,
+)
+
+
+@pytest.mark.parametrize("n_clients,rounds,ops_per_round,seed", [
+    (2, 30, 2, 1),
+    (2, 30, 2, 2),
+    (3, 25, 2, 3),
+    (3, 25, 3, 4),
+    (5, 15, 2, 5),
+    (5, 20, 3, 6),
+    (8, 10, 2, 7),
+])
+def test_conflict_farm(n_clients, rounds, ops_per_round, seed):
+    rng = random.Random(seed)
+    clients = [FarmClient(f"c{i}") for i in range(n_clients)]
+    server = FarmServer(clients, rng)
+    for rnd in range(rounds):
+        # all clients generate ops concurrently (unsequenced)
+        for fc in clients:
+            for _ in range(ops_per_round):
+                random_op(fc, rng)
+        # sequence a random PREFIX, generate more ops mid-stream, then drain
+        # — exercises ops created against partially-delivered state
+        partial = rng.randint(0, server.pending_count())
+        for _ in range(partial):
+            server.sequence_one()
+        for fc in clients:
+            if rng.random() < 0.3:
+                random_op(fc, rng)
+        server.sequence_all()
+        assert_converged(clients, f"seed={seed} round={rnd}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_conflict_farm_inserts_removes_only(seed):
+    """Denser pure insert/remove pressure (the kernel hot path)."""
+    rng = random.Random(1000 + seed)
+    clients = [FarmClient(f"c{i}") for i in range(4)]
+    server = FarmServer(clients, rng)
+    for rnd in range(20):
+        for fc in clients:
+            for _ in range(3):
+                random_op(fc, rng, allow_annotate=False)
+        server.sequence_all()
+        assert_converged(clients, f"ir-seed={seed} round={rnd}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_reconnect_farm(seed):
+    """Random ops + random disconnects: unsequenced ops are dropped at the
+    server and the client resubmits regenerated ops against current state."""
+    rng = random.Random(2000 + seed)
+    clients = [FarmClient(f"c{i}") for i in range(3)]
+    server = FarmServer(clients, rng)
+    for rnd in range(20):
+        for fc in clients:
+            for _ in range(2):
+                random_op(fc, rng)
+        # sequence a random prefix
+        for _ in range(rng.randint(0, server.pending_count())):
+            server.sequence_one()
+        # one client "reconnects": drop its queued ops, rebase, resubmit
+        victim = rng.choice(clients)
+        victim.outbound.clear()
+        for op in victim.client.regenerate_pending_ops():
+            victim.client_seq += 1
+            from fluidframework_tpu.mergetree import op_to_wire
+
+            victim.outbound.append(
+                {
+                    "clientSeq": victim.client_seq,
+                    "refSeq": victim.client.tree.current_seq,
+                    "contents": op_to_wire(op),
+                }
+            )
+        server.sequence_all()
+        assert_converged(clients, f"rc-seed={seed} round={rnd}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_reconnect_storm_farm(seed):
+    """Double reconnects with sequencing in between — regression for the
+    fragment-ordering bugs (segment groups + pending-op renumbering)."""
+    rng = random.Random(3000 + seed)
+    clients = [FarmClient(f"c{i}") for i in range(3)]
+    server = FarmServer(clients, rng)
+
+    def reconnect(fc):
+        fc.outbound.clear()
+        for op in fc.client.regenerate_pending_ops():
+            fc.client_seq += 1
+            from fluidframework_tpu.mergetree import op_to_wire
+
+            fc.outbound.append(
+                {
+                    "clientSeq": fc.client_seq,
+                    "refSeq": fc.client.tree.current_seq,
+                    "contents": op_to_wire(op),
+                }
+            )
+
+    for rnd in range(15):
+        for fc in clients:
+            for _ in range(rng.randint(1, 4)):
+                random_op(fc, rng)
+        for _ in range(rng.randint(0, server.pending_count())):
+            server.sequence_one()
+        for _ in range(rng.randint(0, 2)):
+            reconnect(rng.choice(clients))
+            for _ in range(rng.randint(0, server.pending_count())):
+                server.sequence_one()
+        server.sequence_all()
+        assert_converged(clients, f"storm-seed={seed} round={rnd}")
+
+
+def test_long_document_growth():
+    """A single long-running doc: growth + windowed compaction stay sane."""
+    rng = random.Random(42)
+    clients = [FarmClient(f"c{i}") for i in range(3)]
+    server = FarmServer(clients, rng)
+    for rnd in range(150):
+        for fc in clients:
+            random_op(fc, rng)
+        server.sequence_all()
+    assert_converged(clients, "long-doc")
+    text_len = clients[0].client.get_length()
+    seg_count = len(clients[0].client.tree.segments)
+    # zamboni keeps metadata roughly proportional to text, not to op count
+    assert seg_count < max(200, text_len)
