@@ -1,0 +1,43 @@
+"""TPU tensor kernels for the merge-tree hot path.
+
+The server-side replicas (deli sequencing validation, scribe summaries,
+catch-up replay) apply SEQUENCED ops only — no pending local state — so
+segment visibility is a pure function of int32 stamps and the concurrent-
+insert tie-break degenerates to "earliest boundary" (ops arrive in seq
+order, so no existing stamp can exceed the incoming seq). That makes the
+whole apply step masks + prefix sums + gathers: exactly what vectorizes.
+
+Layout: structure-of-arrays per document, vmapped across a ragged batch of
+documents (ref: the PartialSequenceLengths prefix-sum structure this
+vectorizes, packages/dds/merge-tree/src/partialLengths.ts:62).
+"""
+
+from .doc_state import DocState, TextArena, encode_tree, decode_state, NO_SEQ
+from .apply import (
+    apply_op,
+    apply_op_batch,
+    apply_ops_scan,
+    compact,
+    make_op,
+    OP_NOOP,
+    OP_INSERT,
+    OP_REMOVE,
+    OP_FIELDS,
+)
+
+__all__ = [
+    "DocState",
+    "TextArena",
+    "encode_tree",
+    "decode_state",
+    "NO_SEQ",
+    "apply_op",
+    "apply_op_batch",
+    "apply_ops_scan",
+    "compact",
+    "make_op",
+    "OP_NOOP",
+    "OP_INSERT",
+    "OP_REMOVE",
+    "OP_FIELDS",
+]

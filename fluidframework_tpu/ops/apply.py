@@ -1,0 +1,277 @@
+"""Batched merge-tree delta-apply: the kernel the whole project exists for.
+
+``apply_op`` applies ONE sequenced op to ONE document as pure array math —
+masked prefix-sum position resolution at the op's (refSeq, client)
+perspective, then a static-shape gather rebuild. ``vmap`` lifts it across
+thousands of documents; ``lax.scan`` chains K ops per doc per dispatch.
+
+Server-side invariants that make this simple (see ops/__init__ docstring):
+ops arrive in sequence order, so every existing stamp is below the incoming
+seq — the concurrent-insert tie-break ("higher seq leftward",
+mergeTree.ts:2281 breakTie) reduces to inserting at the EARLIEST boundary,
+and overlapping removes keep the earliest stamp automatically.
+
+Oracle parity is enforced by tests/test_kernel_vs_oracle.py on fuzzed op
+streams (the TPU-build analog of PartialSequenceLengths.options.verify,
+partialLengths.ts:63).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .doc_state import NO_SEQ, DocState
+
+NO_CLIENT = -1
+
+# op vector layout (int32[OP_FIELDS])
+OP_NOOP = 0
+OP_INSERT = 1
+OP_REMOVE = 2
+F_TYPE, F_POS, F_END, F_SEQ, F_REFSEQ, F_CLIENT, F_TLEN, F_TSTART = range(8)
+OP_FIELDS = 8
+
+
+def make_op(
+    type: int,
+    pos: int = 0,
+    end: int = 0,
+    seq: int = 0,
+    ref_seq: int = 0,
+    client: int = 0,
+    text_len: int = 0,
+    text_start: int = 0,
+) -> np.ndarray:
+    v = np.zeros(OP_FIELDS, np.int32)
+    v[F_TYPE], v[F_POS], v[F_END] = type, pos, end
+    v[F_SEQ], v[F_REFSEQ], v[F_CLIENT] = seq, ref_seq, client
+    v[F_TLEN], v[F_TSTART] = text_len, text_start
+    return v
+
+
+def _visibility(state: DocState, ref_seq, client):
+    """Per-slot visibility at the op's perspective → (vis, vlen, cum).
+
+    The branch-free twin of Segment.visible_in / Perspective (all stamps
+    assigned on the server path). ``cum`` is the exclusive prefix sum of
+    visible lengths — the masked-prefix-sum replacement for the reference's
+    PartialSequenceLengths queries (partialLengths.ts:432).
+    """
+    idx = jnp.arange(state.max_slots, dtype=jnp.int32)
+    in_use = idx < state.count
+    ins_seen = (state.ins_client == client) | (state.ins_seq <= ref_seq)
+    removed = (state.rem_seq != NO_SEQ) & (
+        (state.rem_client_a == client)
+        | (state.rem_client_b == client)
+        | (state.rem_seq <= ref_seq)
+    )
+    vis = in_use & ins_seen & ~removed
+    vlen = jnp.where(vis, state.length, 0)
+    cum = jnp.cumsum(vlen) - vlen
+    return vis, vlen, cum
+
+
+def _gather(state: DocState, src, **overrides) -> dict:
+    fields = {}
+    for name in (
+        "length",
+        "text_start",
+        "ins_seq",
+        "ins_client",
+        "rem_seq",
+        "rem_client_a",
+        "rem_client_b",
+    ):
+        fields[name] = getattr(state, name)[src]
+    fields.update(overrides)
+    return fields
+
+
+def _apply_insert(state: DocState, op) -> DocState:
+    S = state.max_slots
+    pos, seq, ref_seq = op[F_POS], op[F_SEQ], op[F_REFSEQ]
+    client, tlen, tstart = op[F_CLIENT], op[F_TLEN], op[F_TSTART]
+    vis, vlen, cum = _visibility(state, ref_seq, client)
+    total = jnp.sum(vlen)
+    inc = cum + vlen
+
+    inside = vis & (cum < pos) & (pos < inc)
+    split = jnp.any(inside)
+    j = jnp.argmax(inside)  # containing slot when split
+    o = pos - cum[j]  # split offset
+    # earliest boundary: first slot whose exclusive prefix reaches pos —
+    # lands BEFORE any run of zero-visible slots (tombstones / concurrent
+    # inserts), matching MergeTree.resolve
+    b = jnp.argmax(cum >= pos)
+    idx = jnp.where(split, j + 1, b)
+
+    i = jnp.arange(S, dtype=jnp.int32)
+    src_boundary = i - (i > idx)
+    src_split = jnp.where(i <= j, i, jnp.where(i <= idx + 1, j, i - 2))
+    src = jnp.clip(jnp.where(split, src_split, src_boundary), 0, S - 1)
+
+    f = _gather(state, src)
+    head = split & (i == j)
+    tail = split & (i == idx + 1)
+    new = i == idx
+    length = jnp.where(head, o, f["length"])
+    length = jnp.where(tail, state.length[j] - o, length)
+    length = jnp.where(new, jnp.where(tlen > 0, tlen, 1), length)
+    text_start = jnp.where(tail, state.text_start[j] + o, f["text_start"])
+    text_start = jnp.where(new, tstart, text_start)
+
+    new_count = state.count + 1 + split.astype(jnp.int32)
+    bad = (pos > total) | (new_count > S)
+    out = DocState(
+        length=length,
+        text_start=text_start,
+        ins_seq=jnp.where(new, seq, f["ins_seq"]),
+        ins_client=jnp.where(new, client, f["ins_client"]),
+        rem_seq=jnp.where(new, NO_SEQ, f["rem_seq"]),
+        rem_client_a=jnp.where(new, NO_CLIENT, f["rem_client_a"]),
+        rem_client_b=jnp.where(new, NO_CLIENT, f["rem_client_b"]),
+        count=new_count,
+        overflow=state.overflow | bad,
+    )
+    return _select_state(bad, state, out)
+
+
+def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
+    """Split the segment strictly containing visible position ``pos``
+    (no-op when pos falls on a boundary)."""
+    S = state.max_slots
+    vis, vlen, cum = _visibility(state, ref_seq, client)
+    inside = vis & (cum < pos) & (pos < cum + vlen)
+    has = jnp.any(inside)
+    j = jnp.argmax(inside)
+    o = pos - cum[j]
+
+    i = jnp.arange(S, dtype=jnp.int32)
+    src = jnp.clip(jnp.where(i <= j, i, jnp.where(i == j + 1, j, i - 1)), 0, S - 1)
+    f = _gather(state, src)
+    head = i == j
+    tail = i == (j + 1)
+    length = jnp.where(head, o, f["length"])
+    length = jnp.where(tail, state.length[j] - o, length)
+    text_start = jnp.where(tail, state.text_start[j] + o, f["text_start"])
+    out = DocState(
+        length=length,
+        text_start=text_start,
+        ins_seq=f["ins_seq"],
+        ins_client=f["ins_client"],
+        rem_seq=f["rem_seq"],
+        rem_client_a=f["rem_client_a"],
+        rem_client_b=f["rem_client_b"],
+        count=state.count + 1,
+        overflow=state.overflow | (has & (state.count + 1 > S)),
+    )
+    return _select_state(~has, state, out)
+
+
+def _apply_remove(state: DocState, op) -> DocState:
+    start, end = op[F_POS], op[F_END]
+    seq, ref_seq, client = op[F_SEQ], op[F_REFSEQ], op[F_CLIENT]
+
+    _, vlen0, _ = _visibility(state, ref_seq, client)
+    bad = (end > jnp.sum(vlen0)) | (end <= start) | (state.count + 2 > state.max_slots)
+
+    st = _split_at(state, start, ref_seq, client)
+    st = _split_at(st, end, ref_seq, client)
+
+    vis, vlen, cum = _visibility(st, ref_seq, client)
+    mask = vis & (cum >= start) & (cum + vlen <= end)
+    fresh = mask & (st.rem_seq == NO_SEQ)
+    # overlap: ops apply in seq order so the existing stamp is the earliest;
+    # just record this client as an additional remover
+    over = mask & (st.rem_seq != NO_SEQ)
+    add_b = over & (st.rem_client_a != client) & (st.rem_client_b == NO_CLIENT)
+    third = over & (st.rem_client_a != client) & (st.rem_client_b != client) & (
+        st.rem_client_b != NO_CLIENT
+    )
+    out = DocState(
+        length=st.length,
+        text_start=st.text_start,
+        ins_seq=st.ins_seq,
+        ins_client=st.ins_client,
+        rem_seq=jnp.where(fresh, seq, st.rem_seq),
+        rem_client_a=jnp.where(fresh, client, st.rem_client_a),
+        rem_client_b=jnp.where(add_b, client, st.rem_client_b),
+        count=st.count,
+        overflow=st.overflow | jnp.any(third) | bad,
+    )
+    return _select_state(bad, state, out)
+
+
+def _select_state(pred, a: DocState, b: DocState) -> DocState:
+    """pred ? a : b, fieldwise (keeping overflow flags from b)."""
+    take = lambda x, y: jnp.where(pred, x, y)
+    return DocState(
+        length=take(a.length, b.length),
+        text_start=take(a.text_start, b.text_start),
+        ins_seq=take(a.ins_seq, b.ins_seq),
+        ins_client=take(a.ins_client, b.ins_client),
+        rem_seq=take(a.rem_seq, b.rem_seq),
+        rem_client_a=take(a.rem_client_a, b.rem_client_a),
+        rem_client_b=take(a.rem_client_b, b.rem_client_b),
+        count=take(a.count, b.count),
+        overflow=b.overflow,  # sticky: set by whichever path ran
+    )
+
+
+def apply_op(state: DocState, op) -> DocState:
+    """Apply one sequenced op vector (int32[OP_FIELDS]) to one doc."""
+    return lax.switch(
+        jnp.clip(op[F_TYPE], 0, 2),
+        [lambda s, o: s, _apply_insert, _apply_remove],
+        state,
+        op,
+    )
+
+
+# [D docs] × one op each
+apply_op_batch = jax.vmap(apply_op)
+
+
+def apply_ops_scan(state: DocState, ops) -> DocState:
+    """Apply K sequenced ops (int32[K, OP_FIELDS]) to one doc, in order."""
+
+    def step(s, op):
+        return apply_op(s, op), None
+
+    out, _ = lax.scan(step, state, ops)
+    return out
+
+
+# [D docs] × [K ops each]: the batched hot loop
+apply_ops_batch = jax.vmap(apply_ops_scan)
+
+
+def compact(state: DocState, min_seq) -> DocState:
+    """Zamboni, device-side: drop slots whose remove seq ≤ minSeq (no future
+    perspective can see them; ref mergeTree.ts:1455) and re-pack in order."""
+    S = state.max_slots
+    i = jnp.arange(S, dtype=jnp.int32)
+    in_use = i < state.count
+    drop = in_use & (state.rem_seq != NO_SEQ) & (state.rem_seq <= min_seq)
+    keep = in_use & ~drop
+    order = jnp.argsort(jnp.where(keep, i, S + i))  # kept first, stable
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    live = jnp.arange(S, dtype=jnp.int32) < new_count
+    g = lambda a, fill: jnp.where(live, a[order], fill)
+    return DocState(
+        length=g(state.length, 0),
+        text_start=g(state.text_start, 0),
+        ins_seq=g(state.ins_seq, 0),
+        ins_client=g(state.ins_client, NO_CLIENT),
+        rem_seq=g(state.rem_seq, NO_SEQ),
+        rem_client_a=g(state.rem_client_a, NO_CLIENT),
+        rem_client_b=g(state.rem_client_b, NO_CLIENT),
+        count=new_count,
+        overflow=state.overflow,
+    )
+
+
+compact_batch = jax.vmap(compact)

@@ -1,0 +1,167 @@
+"""Device-resident document state: structure-of-arrays segment store.
+
+Per document, ``max_slots`` fixed-capacity int32 arrays (XLA needs static
+shapes; capacity overflow raises a per-doc flag for host escalation):
+
+- ``length``     segment length (0 ⇒ unused slot; markers have length 1)
+- ``text_start`` offset into the host-side text arena; segment splits are
+                 pure arithmetic (tail start = head start + offset), so the
+                 device never touches text bytes
+- ``ins_seq``, ``ins_client``          insert stamp
+- ``rem_seq``    earliest remove seq (NO_SEQ = never removed)
+- ``rem_client_a``, ``rem_client_b``   up to two removing clients; a third
+                 concurrent remover of the same segment sets ``overflow``
+                 and the host replays that doc on the scalar oracle
+- ``count``      used slots (slots [0, count) are ordered and contiguous)
+
+Ref: this is the tensorized form of the segment metadata in
+packages/dds/merge-tree/src/mergeTree.ts (insert/remove stamps) with the
+per-block PartialSequenceLengths cache (partialLengths.ts:62) replaced by
+on-the-fly masked prefix sums.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..mergetree.mergetree import MergeTree
+from ..mergetree.segments import NO_CLIENT, Segment
+
+NO_SEQ = -1  # "never removed" sentinel
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DocState:
+    """One document (or, with a leading batch dim, D documents)."""
+
+    length: jax.Array  # [S] int32
+    text_start: jax.Array  # [S] int32
+    ins_seq: jax.Array  # [S] int32
+    ins_client: jax.Array  # [S] int32
+    rem_seq: jax.Array  # [S] int32
+    rem_client_a: jax.Array  # [S] int32
+    rem_client_b: jax.Array  # [S] int32
+    count: jax.Array  # [] int32
+    overflow: jax.Array  # [] bool — capacity or remove-client overflow
+
+    @property
+    def max_slots(self) -> int:
+        return self.length.shape[-1]
+
+    @classmethod
+    def empty(cls, max_slots: int) -> "DocState":
+        z = jnp.zeros((max_slots,), jnp.int32)
+        return cls(
+            length=z,
+            text_start=z,
+            ins_seq=z,
+            ins_client=jnp.full((max_slots,), NO_CLIENT, jnp.int32),
+            rem_seq=jnp.full((max_slots,), NO_SEQ, jnp.int32),
+            rem_client_a=jnp.full((max_slots,), NO_CLIENT, jnp.int32),
+            rem_client_b=jnp.full((max_slots,), NO_CLIENT, jnp.int32),
+            count=jnp.asarray(0, jnp.int32),
+            overflow=jnp.asarray(False, jnp.bool_),
+        )
+
+
+class TextArena:
+    """Host-side append-only text store; the device sees only offsets."""
+
+    def __init__(self):
+        self._chunks: list[str] = []
+        self._len = 0
+
+    def append(self, text: str) -> int:
+        start = self._len
+        self._chunks.append(text)
+        self._len += len(text)
+        return start
+
+    def text(self) -> str:
+        if len(self._chunks) > 1:
+            self._chunks = ["".join(self._chunks)]
+        return self._chunks[0] if self._chunks else ""
+
+    def slice(self, start: int, length: int) -> str:
+        return self.text()[start : start + length]
+
+
+def encode_tree(tree: MergeTree, arena: TextArena, max_slots: int) -> DocState:
+    """Encode a (fully-acked) oracle MergeTree into device arrays.
+
+    Used to upload a doc snapshot to the device batch and by the
+    kernel-vs-oracle validation tests.
+    """
+    n = len(tree.segments)
+    if n > max_slots:
+        raise ValueError(f"{n} segments exceed {max_slots} slots")
+    length = np.zeros(max_slots, np.int32)
+    text_start = np.zeros(max_slots, np.int32)
+    ins_seq = np.zeros(max_slots, np.int32)
+    ins_client = np.full(max_slots, NO_CLIENT, np.int32)
+    rem_seq = np.full(max_slots, NO_SEQ, np.int32)
+    rem_a = np.full(max_slots, NO_CLIENT, np.int32)
+    rem_b = np.full(max_slots, NO_CLIENT, np.int32)
+    overflow = False
+    for i, seg in enumerate(tree.segments):
+        if seg.is_pending():
+            raise ValueError("cannot encode pending local state")
+        length[i] = seg.length
+        text_start[i] = arena.append("￼" if seg.is_marker else seg.text)
+        ins_seq[i] = seg.ins_seq
+        ins_client[i] = seg.ins_client
+        if seg.rem_seq is not None:
+            rem_seq[i] = seg.rem_seq
+            removers = sorted(seg.rem_clients)
+            rem_a[i] = removers[0]
+            if len(removers) > 1:
+                rem_b[i] = removers[1]
+            if len(removers) > 2:
+                overflow = True
+    return DocState(
+        length=jnp.asarray(length),
+        text_start=jnp.asarray(text_start),
+        ins_seq=jnp.asarray(ins_seq),
+        ins_client=jnp.asarray(ins_client),
+        rem_seq=jnp.asarray(rem_seq),
+        rem_client_a=jnp.asarray(rem_a),
+        rem_client_b=jnp.asarray(rem_b),
+        count=jnp.asarray(n, jnp.int32),
+        overflow=jnp.asarray(overflow, jnp.bool_),
+    )
+
+
+def decode_state(state: DocState, arena: TextArena) -> MergeTree:
+    """Decode device arrays back into an oracle MergeTree (for comparison,
+    summaries, and host escalation)."""
+    tree = MergeTree()
+    count = int(state.count)
+    length = np.asarray(state.length)
+    text_start = np.asarray(state.text_start)
+    ins_seq = np.asarray(state.ins_seq)
+    ins_client = np.asarray(state.ins_client)
+    rem_seq = np.asarray(state.rem_seq)
+    rem_a = np.asarray(state.rem_client_a)
+    rem_b = np.asarray(state.rem_client_b)
+    for i in range(count):
+        text = arena.slice(int(text_start[i]), int(length[i]))
+        is_marker = text == "￼"
+        seg = Segment(
+            text="" if is_marker else text,
+            marker={"refType": 1} if is_marker else None,
+            ins_seq=int(ins_seq[i]),
+            ins_client=int(ins_client[i]),
+        )
+        if rem_seq[i] != NO_SEQ:
+            seg.rem_seq = int(rem_seq[i])
+            seg.rem_client = int(rem_a[i])
+            seg.rem_clients = {int(rem_a[i])}
+            if rem_b[i] != NO_CLIENT:
+                seg.rem_clients.add(int(rem_b[i]))
+        tree.segments.append(seg)
+    return tree

@@ -1,0 +1,263 @@
+"""Kernel == oracle on fuzzed sequenced op streams.
+
+The TPU-build analog of the reference's PartialSequenceLengths.options.verify
+(partialLengths.ts:63): every kernel state is cross-checked against the
+scalar oracle — per-character stamps and perspective-visible texts at
+random past (refSeq, client) views.
+
+Runs on CPU (conftest pins JAX_PLATFORMS=cpu); the same jitted code runs on
+TPU in bench.py.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.mergetree import MergeTreeClient, Perspective
+from fluidframework_tpu.ops import (
+    DocState,
+    TextArena,
+    apply_op,
+    decode_state,
+    encode_tree,
+    make_op,
+    OP_INSERT,
+    OP_REMOVE,
+)
+from fluidframework_tpu.ops.apply import apply_ops_scan, compact
+from fluidframework_tpu.protocol import MessageType, SequencedDocumentMessage
+from tests.mergetree_fixtures import FarmClient, FarmServer, random_op
+
+
+def norm_chars(tree, min_seq, view):
+    """Per-char (char?, norm insert stamp, norm remove stamp) for comparison.
+
+    Stamps at or below min_seq are equivalence-classed to 0 (always visible /
+    removed in every reachable perspective) so oracle-side zamboni merging
+    doesn't produce spurious diffs.
+    """
+    out = []
+    for seg in tree.segments:
+        if not seg.visible_in(view):
+            continue
+        ins = (0, -2) if seg.ins_seq <= min_seq else (seg.ins_seq, seg.ins_client)
+        rem = None
+        if seg.rem_seq is not None:
+            rem = seg.rem_seq
+        body = "￼" if seg.is_marker else seg.text
+        for ch in body:
+            out.append((ch, ins, rem))
+    return out
+
+
+_jit_apply = jax.jit(apply_op)
+_jit_compact = jax.jit(compact)
+_jit_scan = jax.jit(apply_ops_scan)
+
+
+class KernelDoc:
+    """Host driver for a single kernel doc: arena + jitted apply."""
+
+    def __init__(self, max_slots=256):
+        self.state = DocState.empty(max_slots)
+        self.arena = TextArena()
+        self._apply = _jit_apply
+        self._compact = _jit_compact
+
+    def apply_wire(self, msg, intern):
+        c = msg.contents
+        client = intern(msg.client_id)
+        if c["type"] == 0:  # insert
+            text = c.get("text")
+            if text is None:
+                text = "￼"  # marker placeholder
+            start = self.arena.append(text)
+            op = make_op(
+                OP_INSERT,
+                pos=c["pos"],
+                seq=msg.sequence_number,
+                ref_seq=msg.reference_sequence_number,
+                client=client,
+                text_len=len(text),
+                text_start=start,
+            )
+        elif c["type"] == 1:  # remove
+            op = make_op(
+                OP_REMOVE,
+                pos=c["start"],
+                end=c["end"],
+                seq=msg.sequence_number,
+                ref_seq=msg.reference_sequence_number,
+                client=client,
+            )
+        else:
+            return
+        self.state = self._apply(self.state, jnp.asarray(op))
+
+    def compact_to(self, min_seq):
+        self.state = self._compact(self.state, jnp.asarray(min_seq, jnp.int32))
+
+
+def run_stream(seed, n_clients=3, rounds=8, compact_every=0):
+    """Drive a farm, feed the sequenced stream to oracle server replica AND
+    kernel, compare after every round."""
+    rng = random.Random(seed)
+    clients = [FarmClient(f"c{i}") for i in range(n_clients)]
+    server = FarmServer(clients, rng)
+
+    oracle = MergeTreeClient("__server__")
+    kernel = KernelDoc()
+    stream: list[SequencedDocumentMessage] = []
+    escalations: list[int] = []
+
+    for rnd in range(rounds):
+        for fc in clients:
+            for _ in range(rng.randint(1, 3)):
+                random_op(fc, rng, allow_annotate=False)
+        while True:
+            ready = [c for c in clients if c.outbound]
+            if not ready:
+                break
+            sender = rng.choice(ready)
+            raw = sender.outbound.popleft()
+            server.seq += 1
+            server.client_ref[sender.name] = max(
+                server.client_ref[sender.name], raw["refSeq"]
+            )
+            msn = min(server.client_ref.values())
+            msg = SequencedDocumentMessage(
+                client_id=sender.name,
+                sequence_number=server.seq,
+                minimum_sequence_number=msn,
+                client_sequence_number=raw["clientSeq"],
+                reference_sequence_number=raw["refSeq"],
+                type=MessageType.OPERATION,
+                contents=raw["contents"],
+            )
+            for c in clients:
+                c.client.apply_msg(msg)
+            oracle.apply_msg(msg)
+            kernel.apply_wire(msg, oracle.intern)
+            stream.append(msg)
+        if compact_every and rnd % compact_every == compact_every - 1:
+            kernel.compact_to(oracle.tree.min_seq)
+
+        # Host-escalation protocol (production behavior): a doc whose state
+        # exceeds the kernel's fixed bounds (3+ concurrent removers of one
+        # segment, or slot capacity) is flagged, replayed host-side on the
+        # oracle, and re-uploaded once its state encodes cleanly again.
+        if bool(kernel.state.overflow):
+            escalations.append(rnd)
+            arena = TextArena()
+            st = encode_tree(oracle.tree, arena, kernel.state.max_slots)
+            if not bool(st.overflow):
+                kernel.state, kernel.arena = st, arena
+        if not bool(kernel.state.overflow):
+            compare(oracle, kernel, stream, rng, f"seed={seed} round={rnd}")
+    assert not bool(kernel.state.overflow), "doc never de-escalated"
+    return oracle, kernel, stream
+
+
+def compare(oracle, kernel, stream, rng, ctx):
+    ktree = decode_state(kernel.state, kernel.arena)
+    min_seq = oracle.tree.min_seq
+    # 1) current server view: text + per-char stamps
+    cur = Perspective(oracle.tree.current_seq, 10**7)
+    o_chars = norm_chars(oracle.tree, min_seq, cur)
+    k_chars = norm_chars(ktree, min_seq, cur)
+    assert o_chars == k_chars, (
+        f"{ctx}: char/stamp mismatch\noracle: {o_chars[:40]}\nkernel: {k_chars[:40]}"
+    )
+    # 2) random past perspectives (only refSeq ≥ minSeq are reachable)
+    for _ in range(5):
+        ref = rng.randint(min_seq, oracle.tree.current_seq)
+        client = rng.choice(list(oracle._ids.values()) + [10**7])
+        view = Perspective(ref, client)
+        o_text = oracle.tree.get_text(view)
+        k_text = ktree.get_text(view)
+        assert o_text == k_text, f"{ctx}: past view ({ref},{client}) diverged"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_kernel_matches_oracle(seed):
+    run_stream(seed, n_clients=3, rounds=8)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_kernel_matches_oracle_with_compaction(seed):
+    run_stream(100 + seed, n_clients=4, rounds=8, compact_every=2)
+
+
+def test_kernel_scan_batch_matches_single_op_path():
+    """K-op lax.scan dispatch == sequential single-op dispatch."""
+    rng = random.Random(7)
+    clients = [FarmClient(f"c{i}") for i in range(3)]
+    server = FarmServer(clients, rng)
+    oracle = MergeTreeClient("__server__")
+    msgs = []
+    for fc in clients:
+        for _ in range(6):
+            random_op(fc, rng, allow_annotate=False)
+    # sequence all, collecting messages
+    while True:
+        ready = [c for c in clients if c.outbound]
+        if not ready:
+            break
+        sender = rng.choice(ready)
+        raw = sender.outbound.popleft()
+        server.seq += 1
+        msg = SequencedDocumentMessage(
+            client_id=sender.name,
+            sequence_number=server.seq,
+            minimum_sequence_number=0,
+            client_sequence_number=raw["clientSeq"],
+            reference_sequence_number=raw["refSeq"],
+            type=MessageType.OPERATION,
+            contents=raw["contents"],
+        )
+        for c in clients:
+            c.client.apply_msg(msg)
+        msgs.append(msg)
+
+    single = KernelDoc()
+    ops = []
+    for m in msgs:
+        c = m.contents
+        client = oracle.intern(m.client_id)
+        if c["type"] == 0:
+            text = c.get("text") or "￼"
+            start = single.arena.append(text)
+            ops.append(
+                make_op(
+                    OP_INSERT,
+                    pos=c["pos"],
+                    seq=m.sequence_number,
+                    ref_seq=m.reference_sequence_number,
+                    client=client,
+                    text_len=len(text),
+                    text_start=start,
+                )
+            )
+        else:
+            ops.append(
+                make_op(
+                    OP_REMOVE,
+                    pos=c["start"],
+                    end=c["end"],
+                    seq=m.sequence_number,
+                    ref_seq=m.reference_sequence_number,
+                    client=client,
+                )
+            )
+        single.state = _jit_apply(single.state, jnp.asarray(ops[-1]))
+
+    scanned = _jit_scan(DocState.empty(256), jnp.asarray(np.stack(ops)))
+    for f in ("length", "text_start", "ins_seq", "ins_client", "rem_seq", "count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(scanned, f)), np.asarray(getattr(single.state, f)), f
+        )
