@@ -1,0 +1,71 @@
+"""Headline benchmark: batched merge-tree sequenced-op apply throughput.
+
+Measures merge-tree ops/sec across a batch of concurrent documents on one
+chip — the TPU analog of BASELINE.md config 4 (N SharedString docs of
+concurrent edits). Prints ONE JSON line; vs_baseline is against the
+north-star target of 50,000 ops/sec (BASELINE.json — the reference repo
+publishes no numbers, so the north star is the bar).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NORTH_STAR_OPS_PER_SEC = 50_000.0
+
+
+def main() -> None:
+    from fluidframework_tpu.ops.apply import apply_ops_batch, compact_batch
+    from fluidframework_tpu.ops.doc_state import DocState
+    from fluidframework_tpu.ops.opgen import generate_batch_ops
+
+    D, S, K, NB = 512, 512, 32, 4  # docs × slots × ops/dispatch × dispatches
+    rng = np.random.default_rng(42)
+
+    @jax.jit
+    def step(state, ops, min_seq):
+        state = apply_ops_batch(state, ops)
+        return compact_batch(state, jnp.broadcast_to(min_seq, state.count.shape))
+
+    state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+    # one continuous valid stream of K*NB ops per doc, split into NB dispatches
+    stream = generate_batch_ops(rng, D, K * NB, remove_fraction=0.45, max_insert=8)
+    batches = [jnp.asarray(stream[:, i * K : (i + 1) * K]) for i in range(NB)]
+    min_seq = jnp.asarray(0, jnp.int32)
+
+    # compile + warm up
+    state = jax.block_until_ready(step(state, batches[0], min_seq))
+
+    n_rounds = 8
+    fresh = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+    finals = []  # keep every round's end state so no dispatch escapes the wait
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        cur = fresh  # streams are generated against an empty doc
+        for ops in batches:
+            cur = step(cur, ops, min_seq)
+        finals.append(cur.count)
+    jax.block_until_ready(finals)
+    dt = time.perf_counter() - t0
+
+    assert not bool(jnp.any(finals[-1] == 0)), "streams failed to apply"
+    ops_per_sec = D * K * NB * n_rounds / dt
+    print(
+        json.dumps(
+            {
+                "metric": "merge_tree_ops_per_sec",
+                "value": round(ops_per_sec, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(ops_per_sec / NORTH_STAR_OPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,16 +52,21 @@ def make_op(
     return v
 
 
-def _visibility(state: DocState, ref_seq, client):
+def _visibility(state: DocState, ref_seq, client, count=None):
     """Per-slot visibility at the op's perspective → (vis, vlen, cum).
 
     The branch-free twin of Segment.visible_in / Perspective (all stamps
     assigned on the server path). ``cum`` is the exclusive prefix sum of
     visible lengths — the masked-prefix-sum replacement for the reference's
     PartialSequenceLengths queries (partialLengths.ts:432).
+
+    ``count`` overrides ``state.count`` for callers whose slot arrays are a
+    shard of a larger doc (parallel/long_doc.py passes the local count).
     """
-    idx = jnp.arange(state.max_slots, dtype=jnp.int32)
-    in_use = idx < state.count
+    if count is None:
+        count = state.count
+    idx = jnp.arange(state.length.shape[-1], dtype=jnp.int32)
+    in_use = idx < count
     ins_seen = (state.ins_client == client) | (state.ins_seq <= ref_seq)
     removed = (state.rem_seq != NO_SEQ) & (
         (state.rem_client_a == client)

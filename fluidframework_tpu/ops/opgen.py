@@ -1,0 +1,71 @@
+"""Sequenced-op stream generator for benchmarks and load tests.
+
+Generates valid server-side op streams (seq strictly increasing, ref_seq =
+previous seq, positions within the tracked visible length) without running
+the oracle — the analytic twin of the reference's load generator
+(packages/test/service-load-test/src/nodeStressTest.ts). Because every op's
+ref_seq sees all prior ops, the visible length after each op is exact:
++text_len on insert, -(end-start) on remove.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .apply import OP_FIELDS, OP_INSERT, OP_NOOP, OP_REMOVE, make_op
+
+
+def generate_doc_ops(
+    rng: np.random.Generator,
+    n_ops: int,
+    start_seq: int = 0,
+    start_len: int = 0,
+    n_clients: int = 4,
+    remove_fraction: float = 0.3,
+    max_insert: int = 16,
+    arena_base: int = 0,
+) -> tuple[np.ndarray, int, int]:
+    """Return (ops[n_ops, OP_FIELDS], end_len, arena_used)."""
+    ops = np.zeros((n_ops, OP_FIELDS), np.int32)
+    length = start_len
+    arena = arena_base
+    seq = start_seq
+    for k in range(n_ops):
+        seq += 1
+        client = int(rng.integers(0, n_clients))
+        do_remove = length > 4 and rng.random() < remove_fraction
+        if do_remove:
+            start = int(rng.integers(0, length - 1))
+            end = int(rng.integers(start + 1, min(length, start + max_insert) + 1))
+            ops[k] = make_op(
+                OP_REMOVE, pos=start, end=end, seq=seq, ref_seq=seq - 1, client=client
+            )
+            length -= end - start
+        else:
+            tlen = int(rng.integers(1, max_insert + 1))
+            pos = int(rng.integers(0, length + 1))
+            ops[k] = make_op(
+                OP_INSERT,
+                pos=pos,
+                seq=seq,
+                ref_seq=seq - 1,
+                client=client,
+                text_len=tlen,
+                text_start=arena,
+            )
+            arena += tlen
+            length += tlen
+    return ops, length, arena - arena_base
+
+
+def generate_batch_ops(
+    rng: np.random.Generator,
+    n_docs: int,
+    ops_per_doc: int,
+    **kw,
+) -> np.ndarray:
+    """[n_docs, ops_per_doc, OP_FIELDS] independent valid streams."""
+    out = np.zeros((n_docs, ops_per_doc, OP_FIELDS), np.int32)
+    for d in range(n_docs):
+        out[d], _, _ = generate_doc_ops(rng, ops_per_doc, **kw)
+    return out

@@ -1,0 +1,26 @@
+"""Hardware parallelism: the TPU mapping of the reference's scale-out axes.
+
+The reference scales by (SURVEY §2.10): document-sharded data parallelism
+(Kafka partitions keyed by (tenant,doc) — lambdas-driver
+kafka-service/partitionManager.ts:22), pipeline stages connected by the
+sequenced-op log, and horizontal front-end scale-out. Here those become:
+
+- ``mesh``          device mesh construction ('docs' × 'seg' axes)
+- ``sharded_apply`` doc-sharded batched merge-tree apply (the DP analog)
+- ``placement``     doc → shard routing table (the partition-key analog)
+- ``long_doc``      segment-sharded prefix sums for giant single docs
+                    (the SP/context-parallel analog; ref §5.7)
+"""
+
+from .mesh import make_mesh
+from .placement import DocPlacement
+from .sharded_apply import make_sharded_step
+from .long_doc import sharded_visible_prefix, sharded_resolve_position
+
+__all__ = [
+    "make_mesh",
+    "DocPlacement",
+    "make_sharded_step",
+    "sharded_visible_prefix",
+    "sharded_resolve_position",
+]

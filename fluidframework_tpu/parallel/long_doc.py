@@ -1,0 +1,65 @@
+"""Segment-sharded kernels for giant single documents (the SP analog).
+
+The reference bounds per-query cost on long documents with per-block
+partial length sums (merge-tree partialLengths.ts:62) — a prefix-sum
+cache over B-tree blocks. Sharding one doc's slot arrays over the 'seg'
+mesh axis makes that literally a distributed segmented prefix sum: each
+shard cumsums its local visible lengths, the shard totals are exchanged
+with one ``all_gather`` over ICI, and every shard adds the exclusive sum
+of its predecessors. Position resolution is then a local search plus a
+one-hot vote across shards. (SURVEY §5.7.)
+
+These functions are written to run INSIDE ``jax.shard_map`` with the slot
+axis sharded over 'seg'; they are the building block the giant-doc apply
+path composes with the doc-sharded step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.apply import _visibility
+from ..ops.doc_state import DocState
+
+
+def sharded_visible_prefix(state: DocState, ref_seq, client, local_count, axis="seg"):
+    """Global exclusive prefix sum of visible lengths across 'seg' shards.
+
+    Returns (vis, vlen, cum, total): cum[i] is the GLOBAL number of visible
+    characters before local slot i; total is the doc's visible length.
+    Must be called inside shard_map with the slot axis sharded over
+    ``axis``. One all_gather of scalars is the only communication.
+    """
+    vis, vlen, local_cum = _visibility(state, ref_seq, client, count=local_count)
+    local_total = jnp.sum(vlen)
+    shard_totals = jax.lax.all_gather(local_total, axis)  # [n_shards]
+    my = jax.lax.axis_index(axis)
+    offset = jnp.sum(jnp.where(jnp.arange(shard_totals.shape[0]) < my, shard_totals, 0))
+    return vis, vlen, local_cum + offset, jnp.sum(shard_totals)
+
+
+def sharded_resolve_position(
+    state: DocState, pos, ref_seq, client, local_count, axis="seg"
+):
+    """Resolve visible position → (global_slot, offset_in_slot, found).
+
+    The distributed twin of MergeTree.resolve / getContainingSegment
+    (mergeTree.ts:1656): each shard searches its slice against the global
+    prefix, then a max-vote across shards picks the owner.
+    """
+    S = state.length.shape[-1]
+    vis, vlen, cum, total = sharded_visible_prefix(
+        state, ref_seq, client, local_count, axis
+    )
+    inside = vis & (cum <= pos) & (pos < cum + vlen)
+    has_local = jnp.any(inside)
+    j = jnp.argmax(inside)
+    my = jax.lax.axis_index(axis)
+    global_slot = my * S + j
+    offset = pos - cum[j]
+    # exactly one shard can contain an interior position; max-vote selects it
+    vote = jnp.where(has_local, global_slot, -1)
+    winner_slot = jax.lax.pmax(vote, axis)
+    winner_off = jax.lax.pmax(jnp.where(has_local, offset, -1), axis)
+    return winner_slot, winner_off, (winner_slot >= 0) & (pos < total)

@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+Two named axes:
+
+- ``docs`` — document shards (the Kafka-partition analog; independent docs,
+  so this axis only ever carries stats collectives like psum of applied-op
+  counts — never data dependencies between docs).
+- ``seg``  — segment shards within one giant document (the
+  sequence-parallel analog; carries prefix-sum collectives over ICI).
+
+On a real slice the 'docs' axis should span hosts (DCN-tolerant: traffic is
+tiny) while 'seg' stays intra-slice (prefix exchanges ride ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    seg_shards: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('docs', 'seg') mesh over ``n_devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    if n % seg_shards != 0:
+        raise ValueError(f"{n} devices not divisible by seg_shards={seg_shards}")
+    grid = np.asarray(devices).reshape(n // seg_shards, seg_shards)
+    return Mesh(grid, ("docs", "seg"))

@@ -1,0 +1,38 @@
+"""The ordering service: sequencer pipeline + storage + front end.
+
+Ref: server/routerlicious (SURVEY §2.8). The service does NO merge logic —
+it assigns each op a position in a per-document total order, persists it,
+and fans it out; clients do the merging. The pipeline stages are pure
+lambdas (services-core lambdas.ts:36) connected by an ordered log, so the
+same stage code runs over the in-memory log (tests, Tinylicious analog) or
+the C++ sharded log (production analog).
+
+- ``core``         stage/queue/db abstractions (services-core analog)
+- ``local_log``    in-memory ordered log (memory-orderer LocalKafka analog)
+- ``deli``         the sequencer (lambdas/src/deli)
+- ``broadcaster``  fan-out to subscribers (lambdas/src/broadcaster)
+- ``scriptorium``  durable op store for backfill (lambdas/src/scriptorium)
+- ``scribe``       protocol replica + summary commits (lambdas/src/scribe)
+- ``local_orderer``wires real lambdas over the local log (memory-orderer)
+- ``local_server`` in-proc service endpoint (local-server / tinylicious)
+"""
+
+from .core import CheckpointManager, InMemoryDb, Lambda, LambdaContext
+from .deli import DeliCheckpoint, DeliLambda, RawMessage
+from .local_log import LocalLog
+from .local_orderer import LocalOrderer
+from .local_server import LocalServer, ServerConnection
+
+__all__ = [
+    "CheckpointManager",
+    "InMemoryDb",
+    "Lambda",
+    "LambdaContext",
+    "DeliCheckpoint",
+    "DeliLambda",
+    "RawMessage",
+    "LocalLog",
+    "LocalOrderer",
+    "LocalServer",
+    "ServerConnection",
+]

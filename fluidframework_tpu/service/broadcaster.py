@@ -1,0 +1,53 @@
+"""Broadcaster: fan sequenced ops out to every connected front end.
+
+Ref: lambdas/src/broadcaster/lambda.ts:29-80 — batches sequenced ops per
+"tenant/doc" topic and publishes to all front-end instances (Redis pub/sub
+in production; in-proc PubSub here, memory-orderer/src/pubsub.ts:39).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable
+
+from ..protocol.messages import SequencedDocumentMessage
+from .core import QueuedMessage
+
+
+class PubSub:
+    """Topic → subscriber callbacks (ref: memory-orderer pubsub.ts)."""
+
+    def __init__(self):
+        self._subs: dict[str, list[Callable]] = defaultdict(list)
+
+    def subscribe(self, topic: str, cb: Callable) -> None:
+        self._subs[topic].append(cb)
+
+    def unsubscribe(self, topic: str, cb: Callable) -> None:
+        if cb in self._subs.get(topic, []):
+            self._subs[topic].remove(cb)
+
+    def publish(self, topic: str, *args) -> None:
+        for cb in list(self._subs.get(topic, [])):
+            cb(*args)
+
+
+class BroadcasterLambda:
+    """Relays each sequenced message to the doc's pub/sub topic."""
+
+    def __init__(self, pubsub: PubSub):
+        self._pubsub = pubsub
+
+    @staticmethod
+    def topic(tenant_id: str, document_id: str) -> str:
+        return f"{tenant_id}/{document_id}"
+
+    def handler(self, message: QueuedMessage) -> None:
+        envelope = message.value  # {"tenant_id", "document_id", "message"}
+        msg: SequencedDocumentMessage = envelope["message"]
+        self._pubsub.publish(
+            self.topic(envelope["tenant_id"], envelope["document_id"]), msg
+        )
+
+    def close(self) -> None:
+        pass

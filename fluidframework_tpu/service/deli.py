@@ -1,0 +1,273 @@
+"""Deli: the per-document sequencer — THE hot loop of the service.
+
+Ref: lambdas/src/deli/lambda.ts (handler :171 → ticket :253). For each raw
+client message: validate (dup/gap on clientSeq, stale refSeq vs msn),
+assign ``sequenceNumber++``, recompute the document-wide
+``minimumSequenceNumber`` as the min reference seq over connected clients
+(clientSeqManager.ts), stamp a trace hop, and emit the sequenced op.
+Idle clients are expired (5 min default, lambdaFactory.ts:29) so the msn
+can advance past dead clients; state checkpoints as
+``(log_offset, sequence_number, clients)`` (checkpointContext.ts:49) and
+restart replays the log from the checkpoint, skipping already-ticketed
+offsets (lambda.ts:173).
+
+The scalar form below is the semantic reference; the sharded TPU form
+(parallel/sharded_apply.py + a counter per doc slot) batches the same
+ticket rules across thousands of docs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    Nack,
+    NackErrorType,
+    SequencedDocumentMessage,
+    TraceHop,
+)
+from .core import QueuedMessage
+
+DEFAULT_CLIENT_TIMEOUT = 5 * 60.0  # ref: ClientSequenceTimeout, 5 minutes
+
+
+@dataclass
+class RawMessage:
+    """Alfred → deli envelope (ref: core RawOperationMessage)."""
+
+    tenant_id: str
+    document_id: str
+    client_id: Optional[str]  # None for server/system-generated messages
+    operation: DocumentMessage
+    timestamp: float = 0.0
+
+
+@dataclass
+class ClientState:
+    """Per-client sequencing state (ref: deli/clientSeqManager.ts)."""
+
+    client_id: str
+    client_sequence_number: int = 0
+    reference_sequence_number: int = 0
+    last_update: float = 0.0
+    can_evict: bool = True  # summarizer/system clients are not evicted
+    detail: Any = None
+
+
+@dataclass
+class DeliCheckpoint:
+    """Restartable state (ref: deli/checkpointContext.ts:49-92)."""
+
+    log_offset: int = -1
+    sequence_number: int = 0
+    clients: list[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "log_offset": self.log_offset,
+            "sequence_number": self.sequence_number,
+            "clients": self.clients,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeliCheckpoint":
+        return cls(d["log_offset"], d["sequence_number"], list(d["clients"]))
+
+
+class DeliLambda:
+    """Sequencer for ONE document (the document-router demuxes per doc)."""
+
+    def __init__(
+        self,
+        tenant_id: str,
+        document_id: str,
+        send_sequenced: Callable[[SequencedDocumentMessage], None],
+        send_nack: Callable[[str, Nack], None],
+        checkpoint: Optional[DeliCheckpoint] = None,
+        client_timeout: float = DEFAULT_CLIENT_TIMEOUT,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self._send = send_sequenced
+        self._nack = send_nack
+        self._clock = clock
+        self._client_timeout = client_timeout
+        cp = checkpoint or DeliCheckpoint()
+        self.sequence_number = cp.sequence_number
+        self.log_offset = cp.log_offset
+        self.clients: dict[str, ClientState] = {
+            c["client_id"]: ClientState(**c) for c in cp.clients
+        }
+
+    # ------------------------------------------------------------------ api
+
+    def handler(self, message: QueuedMessage) -> None:
+        # idempotent replay after restart (ref: deli/lambda.ts:173)
+        if message.offset <= self.log_offset:
+            return
+        self.log_offset = message.offset
+        raw: RawMessage = message.value
+        self._ticket(raw)
+
+    def checkpoint(self) -> DeliCheckpoint:
+        return DeliCheckpoint(
+            log_offset=self.log_offset,
+            sequence_number=self.sequence_number,
+            clients=[
+                {
+                    "client_id": c.client_id,
+                    "client_sequence_number": c.client_sequence_number,
+                    "reference_sequence_number": c.reference_sequence_number,
+                    "last_update": c.last_update,
+                    "can_evict": c.can_evict,
+                    "detail": c.detail,
+                }
+                for c in self.clients.values()
+            ],
+        )
+
+    def check_idle_clients(self) -> None:
+        """Expire clients idle past the timeout so the msn can advance
+        (ref: deli lambda checkIdleClients / ClientSequenceTimeout)."""
+        now = self._clock()
+        for client_id in [
+            c.client_id
+            for c in self.clients.values()
+            if c.can_evict and now - c.last_update > self._client_timeout
+        ]:
+            self._sequence_system(
+                MessageType.CLIENT_LEAVE, {"clientId": client_id}
+            )
+
+    def close(self) -> None:
+        pass
+
+    # ------------------------------------------------------------- internal
+
+    def _min_ref_seq(self) -> int:
+        """msn = min reference seq over connected clients; with no clients
+        the msn rides the sequence number (ref: clientSeqManager heap)."""
+        if not self.clients:
+            return self.sequence_number
+        return min(c.reference_sequence_number for c in self.clients.values())
+
+    def _ticket(self, raw: RawMessage) -> None:
+        op = raw.operation
+        now = raw.timestamp or self._clock()
+
+        if op.type == MessageType.CLIENT_JOIN:
+            # system message from the front end; content names the client
+            content = op.contents or {}
+            client_id = content.get("clientId")
+            if client_id in self.clients:
+                return  # duplicate join
+            self.clients[client_id] = ClientState(
+                client_id=client_id,
+                reference_sequence_number=self.sequence_number,
+                last_update=now,
+                can_evict=content.get("canEvict", True),
+                detail=content.get("detail"),
+            )
+            self._sequence_system(MessageType.CLIENT_JOIN, content)
+            return
+
+        if op.type == MessageType.CLIENT_LEAVE:
+            client_id = (op.contents or {}).get("clientId")
+            if client_id not in self.clients:
+                return  # duplicate leave
+            self._sequence_system(MessageType.CLIENT_LEAVE, op.contents)
+            return
+
+        # client-originated: must be joined
+        client = self.clients.get(raw.client_id)
+        if client is None:
+            self._nack(
+                raw.client_id,
+                Nack(
+                    operation=op,
+                    sequence_number=self.sequence_number,
+                    code=400,
+                    type=NackErrorType.BAD_REQUEST,
+                    message="client not connected (no join on record)",
+                ),
+            )
+            return
+
+        # clientSeq dup/gap detection (ref: deli lambda.ts:264-271)
+        expected = client.client_sequence_number + 1
+        if op.client_sequence_number < expected:
+            return  # duplicate: already sequenced (reconnect replay)
+        if op.client_sequence_number > expected:
+            self._nack(
+                raw.client_id,
+                Nack(
+                    operation=op,
+                    sequence_number=self.sequence_number,
+                    code=400,
+                    type=NackErrorType.BAD_REQUEST,
+                    message=f"clientSeq gap: expected {expected}, "
+                    f"got {op.client_sequence_number}",
+                ),
+            )
+            return
+
+        # refSeq below the collaboration window floor is unresolvable
+        msn = self._min_ref_seq()
+        if op.reference_sequence_number < msn:
+            self._nack(
+                raw.client_id,
+                Nack(
+                    operation=op,
+                    sequence_number=self.sequence_number,
+                    code=400,
+                    type=NackErrorType.BAD_REQUEST,
+                    message=f"refSeq {op.reference_sequence_number} below msn {msn}",
+                ),
+            )
+            return
+
+        client.client_sequence_number = op.client_sequence_number
+        client.reference_sequence_number = op.reference_sequence_number
+        client.last_update = now
+
+        self.sequence_number += 1
+        traces = list(op.traces)
+        traces.append(TraceHop(service="deli", action="sequence", timestamp=now))
+        self._send(
+            SequencedDocumentMessage(
+                client_id=raw.client_id,
+                sequence_number=self.sequence_number,
+                minimum_sequence_number=self._min_ref_seq(),
+                client_sequence_number=op.client_sequence_number,
+                reference_sequence_number=op.reference_sequence_number,
+                type=op.type,
+                contents=op.contents,
+                metadata=op.metadata,
+                timestamp=now,
+                traces=traces,
+            )
+        )
+
+    def _sequence_system(self, type: MessageType, contents: Any) -> None:
+        """Sequence a server-generated message (join/leave/noClient)."""
+        if type == MessageType.CLIENT_LEAVE:
+            self.clients.pop((contents or {}).get("clientId"), None)
+        self.sequence_number += 1
+        self._send(
+            SequencedDocumentMessage(
+                client_id=None,
+                sequence_number=self.sequence_number,
+                minimum_sequence_number=self._min_ref_seq(),
+                client_sequence_number=-1,
+                reference_sequence_number=-1,
+                type=type,
+                contents=contents,
+                timestamp=self._clock(),
+                traces=[TraceHop(service="deli", action="sequence")],
+            )
+        )

@@ -1,0 +1,92 @@
+"""In-memory ordered log: the LocalKafka analog.
+
+Ref: memory-orderer/src/localKafka.ts — an append-only per-partition
+message list with monotonically increasing offsets, drained synchronously
+into subscribed lambdas. Deterministic drain order (topic registration
+order, then offset order) is what makes multi-client interleaving tests
+reproducible (the OpProcessingController property, SURVEY §4).
+
+The production analog is the C++ sharded op log (SURVEY §2.9); both sides
+present the same (append → offset, subscribe → in-order handler calls)
+contract, so every lambda runs unchanged over either.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .core import QueuedMessage
+
+
+class LocalLog:
+    """Named topics of ordered partitions with subscriber fan-out."""
+
+    def __init__(self):
+        self._topics: dict[str, list[QueuedMessage]] = {}
+        # subscriber positions: (topic, id) -> next offset to deliver
+        self._subs: dict[str, list[tuple[Callable[[QueuedMessage], None], list[int]]]] = {}
+        self._order: list[str] = []
+
+    def create_topic(self, topic: str) -> None:
+        if topic not in self._topics:
+            self._topics[topic] = []
+            self._subs[topic] = []
+            self._order.append(topic)
+
+    def append(self, topic: str, value: Any, partition: int = 0) -> int:
+        self.create_topic(topic)
+        log = self._topics[topic]
+        offset = len(log)
+        log.append(QueuedMessage(offset=offset, topic=topic, partition=partition, value=value))
+        return offset
+
+    def subscribe(
+        self,
+        topic: str,
+        handler: Callable[[QueuedMessage], None],
+        from_offset: int = 0,
+    ) -> None:
+        self.create_topic(topic)
+        self._subs[topic].append((handler, [from_offset]))
+
+    def unsubscribe(self, topic: str, handler: Callable[[QueuedMessage], None]) -> None:
+        subs = self._subs.get(topic, [])
+        self._subs[topic] = [(h, p) for h, p in subs if h is not handler]
+
+    def drain(self) -> int:
+        """Deliver pending messages to all subscribers until quiescent.
+
+        Handlers may append more messages (deli → deltas topic); the loop
+        runs to a fixed point. Returns the number of deliveries made.
+        """
+        delivered = 0
+        progressed = True
+        while progressed:
+            progressed = False
+            for topic in self._order:
+                log = self._topics[topic]
+                for handler, pos in self._subs[topic]:
+                    while pos[0] < len(log):
+                        msg = log[pos[0]]
+                        pos[0] += 1
+                        handler(msg)
+                        delivered += 1
+                        progressed = True
+        return delivered
+
+    def step(self, topic: str) -> bool:
+        """Deliver exactly ONE pending message on ``topic`` to each lagging
+        subscriber — the deterministic single-step used by interleaving
+        tests. Returns False when the topic is fully drained."""
+        log = self._topics.get(topic, [])
+        any_delivered = False
+        for handler, pos in self._subs.get(topic, []):
+            if pos[0] < len(log):
+                msg = log[pos[0]]
+                pos[0] += 1
+                handler(msg)
+                any_delivered = True
+        return any_delivered
+
+    def length(self, topic: str) -> int:
+        return len(self._topics.get(topic, []))

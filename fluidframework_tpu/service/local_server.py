@@ -1,0 +1,252 @@
+"""LocalServer: the complete service in one process.
+
+Ref: local-server/src/localDeltaConnectionServer.ts:59-118 (the test
+backbone) and server/tinylicious (the single-process deployment). The
+connection handshake mirrors alfred's ``connect_document``
+(lambdas/src/alfred/index.ts:112-310): assign a client id, sequence a join
+op, hand back the current sequence state; ``submit_op`` orders client
+messages; disconnect sequences a leave. Signals are relayed un-sequenced
+(:405).
+
+``auto_drain=True`` delivers everything synchronously (the easy mode);
+``auto_drain=False`` + explicit ``drain()``/``step()`` gives tests
+deterministic control over interleaving — the OpProcessingController role.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Optional
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+    Signal,
+)
+from .broadcaster import BroadcasterLambda, PubSub
+from .core import InMemoryDb
+from .deli import RawMessage
+from .local_log import LocalLog
+from .local_orderer import LocalOrderer
+
+
+class ServerConnection:
+    """One client's live connection (the socket analog).
+
+    Callbacks: ``on_op(SequencedDocumentMessage)``, ``on_nack(Nack)``,
+    ``on_signal(Signal)``. Events arriving before a callback is attached
+    are buffered and flushed on attach, so nothing delivered between the
+    handshake and handler registration is lost.
+    """
+
+    def __init__(self, server: "LocalServer", tenant_id: str, document_id: str,
+                 client_id: str, details: Any):
+        self.server = server
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self.client_id = client_id
+        self.details = details
+        self._handlers: dict[str, Optional[Callable]] = {
+            "op": None, "nack": None, "signal": None}
+        self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
+        self.connected = True
+        # sequence state at connect time (ref: IConnected payload)
+        self.initial_sequence_number = 0
+
+    def _deliver(self, kind: str, event) -> None:
+        cb = self._handlers[kind]
+        if cb is None:
+            self._buffers[kind].append(event)
+        else:
+            cb(event)
+
+    def _set_handler(self, kind: str, cb: Optional[Callable]) -> None:
+        self._handlers[kind] = cb
+        if cb is not None:
+            pending, self._buffers[kind] = self._buffers[kind], []
+            for event in pending:
+                cb(event)
+
+    on_op = property(
+        lambda self: self._handlers["op"],
+        lambda self, cb: self._set_handler("op", cb))
+    on_nack = property(
+        lambda self: self._handlers["nack"],
+        lambda self, cb: self._set_handler("nack", cb))
+    on_signal = property(
+        lambda self: self._handlers["signal"],
+        lambda self, cb: self._set_handler("signal", cb))
+
+    def submit(self, messages: list[DocumentMessage]) -> None:
+        if not self.connected:
+            raise RuntimeError("connection closed")
+        self.server._submit(self, messages)
+
+    def submit_signal(self, content: Any, type: str = "signal") -> None:
+        if not self.connected:
+            raise RuntimeError("connection closed")
+        self.server._signal(self, Signal(client_id=self.client_id, type=type,
+                                         content=content))
+
+    def disconnect(self) -> None:
+        if self.connected:
+            self.connected = False
+            self.server._disconnect(self)
+
+
+class LocalServer:
+    def __init__(
+        self,
+        auto_drain: bool = True,
+        clock: Callable[[], float] = time.time,
+        client_timeout: Optional[float] = None,
+    ):
+        self.log = LocalLog()
+        self.db = InMemoryDb()
+        self.pubsub = PubSub()
+        self._orderers: dict[str, LocalOrderer] = {}
+        self._auto_drain = auto_drain
+        self._clock = clock
+        self._client_timeout = client_timeout
+        self._client_counter = itertools.count(1)
+
+    # ------------------------------------------------------------------ api
+
+    def connect(
+        self,
+        tenant_id: str,
+        document_id: str,
+        details: Any = None,
+        can_evict: bool = True,
+    ) -> ServerConnection:
+        """The connect_document handshake: join the quorum, get a live
+        connection primed at the current sequence number."""
+        orderer = self._get_orderer(tenant_id, document_id)
+        client_id = f"client-{next(self._client_counter)}"
+        conn = ServerConnection(self, tenant_id, document_id, client_id, details)
+
+        topic = BroadcasterLambda.topic(tenant_id, document_id)
+        conn._op_cb = lambda msg: conn._deliver("op", msg)
+        conn._nack_cb = lambda nack: conn._deliver("nack", nack)
+        conn._sig_cb = lambda sig: conn._deliver("signal", sig)
+        self.pubsub.subscribe(topic, conn._op_cb)
+        self.pubsub.subscribe(
+            f"nack/{tenant_id}/{document_id}/{client_id}", conn._nack_cb)
+        self.pubsub.subscribe(f"signal/{tenant_id}/{document_id}", conn._sig_cb)
+
+        conn.initial_sequence_number = orderer.deli.sequence_number
+        orderer.order(
+            RawMessage(
+                tenant_id=tenant_id,
+                document_id=document_id,
+                client_id=None,
+                operation=DocumentMessage(
+                    client_sequence_number=-1,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    contents={
+                        "clientId": client_id,
+                        "detail": details,
+                        "canEvict": can_evict,
+                    },
+                ),
+                timestamp=self._clock(),
+            )
+        )
+        self._maybe_drain()
+        return conn
+
+    def get_deltas(
+        self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
+    ) -> list[SequencedDocumentMessage]:
+        """REST backfill (alfred /deltas): ops with from_seq < seq < to_seq."""
+        orderer = self._get_orderer(tenant_id, document_id)
+        return orderer.scriptorium.get_deltas(
+            tenant_id, document_id, from_seq, to_seq)
+
+    def drain(self) -> int:
+        """Deliver all queued messages through the pipeline to quiescence."""
+        return self.log.drain()
+
+    def expire_idle_clients(self) -> None:
+        for orderer in self._orderers.values():
+            orderer.deli.check_idle_clients()
+        self._maybe_drain()
+
+    def checkpoint_all(self) -> None:
+        for orderer in self._orderers.values():
+            orderer.checkpoint()
+
+    def restart_orderer(self, tenant_id: str, document_id: str) -> None:
+        """Simulate a partition restart: tear down the document's pipeline
+        and rebuild it from the db checkpoint (ref: KafkaRunner partition
+        restart, lambdas-driver/src/kafka-service/partition.ts)."""
+        key = f"{tenant_id}/{document_id}"
+        orderer = self._orderers.pop(key, None)
+        if orderer is not None:
+            orderer.checkpoint()
+            orderer.close()
+        self._get_orderer(tenant_id, document_id)
+
+    # ------------------------------------------------------------- internal
+
+    def _get_orderer(self, tenant_id: str, document_id: str) -> LocalOrderer:
+        key = f"{tenant_id}/{document_id}"
+        if key not in self._orderers:
+            kw = {}
+            if self._client_timeout is not None:
+                kw["client_timeout"] = self._client_timeout
+            self._orderers[key] = LocalOrderer(
+                tenant_id, document_id, self.log, self.db, self.pubsub,
+                clock=self._clock, **kw)
+        return self._orderers[key]
+
+    def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:
+        orderer = self._get_orderer(conn.tenant_id, conn.document_id)
+        now = self._clock()
+        for op in messages:
+            orderer.order(
+                RawMessage(
+                    tenant_id=conn.tenant_id,
+                    document_id=conn.document_id,
+                    client_id=conn.client_id,
+                    operation=op,
+                    timestamp=now,
+                )
+            )
+        self._maybe_drain()
+
+    def _signal(self, conn: ServerConnection, signal: Signal) -> None:
+        self.pubsub.publish(
+            f"signal/{conn.tenant_id}/{conn.document_id}", signal)
+
+    def _disconnect(self, conn: ServerConnection) -> None:
+        orderer = self._get_orderer(conn.tenant_id, conn.document_id)
+        orderer.order(
+            RawMessage(
+                tenant_id=conn.tenant_id,
+                document_id=conn.document_id,
+                client_id=None,
+                operation=DocumentMessage(
+                    client_sequence_number=-1,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_LEAVE,
+                    contents={"clientId": conn.client_id},
+                ),
+                timestamp=self._clock(),
+            )
+        )
+        topic = BroadcasterLambda.topic(conn.tenant_id, conn.document_id)
+        self.pubsub.unsubscribe(topic, conn._op_cb)
+        self.pubsub.unsubscribe(
+            f"nack/{conn.tenant_id}/{conn.document_id}/{conn.client_id}",
+            conn._nack_cb)
+        self.pubsub.unsubscribe(
+            f"signal/{conn.tenant_id}/{conn.document_id}", conn._sig_cb)
+        self._maybe_drain()
+
+    def _maybe_drain(self) -> None:
+        if self._auto_drain:
+            self.log.drain()

@@ -1,0 +1,42 @@
+"""Scriptorium: durable sequenced-op store for backfill.
+
+Ref: lambdas/src/scriptorium/lambda.ts:16-48 — inserts each sequenced op
+into the per-document ``deltas`` collection, the source for the REST
+delta-backfill path new/reconnecting clients use to catch up
+(alfred /deltas → DeltaManager.getDeltas, deltaManager.ts:647).
+"""
+
+from __future__ import annotations
+
+from ..protocol.messages import SequencedDocumentMessage
+from .core import InMemoryDb, QueuedMessage
+
+
+class ScriptoriumLambda:
+    def __init__(self, db: InMemoryDb):
+        self._db = db
+
+    @staticmethod
+    def collection(tenant_id: str, document_id: str) -> str:
+        return f"deltas/{tenant_id}/{document_id}"
+
+    def handler(self, message: QueuedMessage) -> None:
+        envelope = message.value
+        msg: SequencedDocumentMessage = envelope["message"]
+        name = self.collection(envelope["tenant_id"], envelope["document_id"])
+        # idempotent on replay: keyed by sequence number
+        self._db.upsert(name, str(msg.sequence_number), {"message": msg})
+
+    def close(self) -> None:
+        pass
+
+    def get_deltas(
+        self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
+    ) -> list[SequencedDocumentMessage]:
+        """Ops with from_seq < seq < to_seq (exclusive bounds, matching the
+        reference's /deltas REST contract)."""
+        name = self.collection(tenant_id, document_id)
+        docs = self._db.find_range(
+            name, lambda d: d["message"].sequence_number, from_seq + 1, to_seq
+        )
+        return [d["message"] for d in docs]

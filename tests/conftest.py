@@ -1,12 +1,19 @@
 """Test configuration: force JAX onto CPU with 8 virtual devices so
-multi-chip sharding paths are exercised without TPU hardware
-(XLA_FLAGS --xla_force_host_platform_device_count, see repo README)."""
+multi-chip sharding paths are exercised without TPU hardware.
+
+The axon TPU plugin (sitecustomize) pins ``jax_platforms="axon,cpu"`` at
+interpreter start, so setting ``JAX_PLATFORMS`` in the environment here is
+too late — the config must be updated through jax after import (safe as
+long as no backend has been initialized, which holds at conftest time)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,228 @@
+"""Service pipeline tests: deli sequencing, nacks, idle expiry, restart
+from checkpoint, broadcast fan-out, scriptorium backfill, signals.
+
+Ref test strategy: routerlicious src/test/alfred/io.spec.ts (socket
+contract), lambdas-driver partition checkpoint tests, local-server
+localDeltaConnectionServer.spec.ts (SURVEY §4).
+"""
+
+from fluidframework_tpu.protocol.messages import DocumentMessage, MessageType
+from fluidframework_tpu.service import LocalServer
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def op(csn, rsn, contents=None):
+    return DocumentMessage(
+        client_sequence_number=csn,
+        reference_sequence_number=rsn,
+        type=MessageType.OPERATION,
+        contents=contents,
+    )
+
+
+def make_client(server, tenant="t", doc="d"):
+    conn = server.connect(tenant, doc)
+    received, nacks, signals = [], [], []
+    conn.on_op = received.append
+    conn.on_nack = nacks.append
+    conn.on_signal = signals.append
+    return conn, received, nacks, signals
+
+
+def test_join_assigns_sequence_and_broadcasts():
+    server = LocalServer()
+    c1, r1, _, _ = make_client(server)
+    c2, r2, _, _ = make_client(server)
+    # both clients see both joins (c1 sees its own join + c2's)
+    assert [m.type for m in r1] == [MessageType.CLIENT_JOIN] * 2
+    assert [m.sequence_number for m in r1] == [1, 2]
+    # c2 connected after join 1 was sequenced; it only sees join 2 live
+    assert [m.sequence_number for m in r2] == [2]
+    assert c2.initial_sequence_number == 1
+
+
+def test_ops_are_totally_ordered_and_fanned_out():
+    server = LocalServer()
+    c1, r1, _, _ = make_client(server)
+    c2, r2, _, _ = make_client(server)
+    c1.submit([op(1, 2, {"x": 1})])
+    c2.submit([op(1, 2, {"x": 2})])
+    ops1 = [m for m in r1 if m.type == MessageType.OPERATION]
+    ops2 = [m for m in r2 if m.type == MessageType.OPERATION]
+    assert [m.sequence_number for m in ops1] == [3, 4]
+    assert [(m.client_id, m.contents) for m in ops1] == [
+        (c1.client_id, {"x": 1}),
+        (c2.client_id, {"x": 2}),
+    ]
+    # identical streams on every client
+    assert [(m.sequence_number, m.client_id) for m in ops1] == [
+        (m.sequence_number, m.client_id) for m in ops2
+    ]
+
+
+def test_msn_is_min_ref_seq_over_clients():
+    server = LocalServer()
+    c1, r1, _, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    c1.submit([op(1, 2)])
+    c2.submit([op(1, 3)])
+    ops = [m for m in r1 if m.type == MessageType.OPERATION]
+    assert ops[-1].minimum_sequence_number == 2  # min(2, 3)
+    # after c1 leaves, msn advances to c2's refSeq
+    c1.disconnect()
+    c2.submit([op(2, 3)])
+    server.drain()
+    deltas = server.get_deltas("t", "d", 0, 100)
+    assert deltas[-1].minimum_sequence_number == 3
+
+
+def test_duplicate_clientseq_ignored_gap_nacked():
+    server = LocalServer()
+    c1, r1, nacks, _ = make_client(server)
+    c1.submit([op(1, 1)])
+    c1.submit([op(1, 1)])  # duplicate: silently dropped
+    ops = [m for m in r1 if m.type == MessageType.OPERATION]
+    assert len(ops) == 1
+    c1.submit([op(5, 1)])  # gap: nacked
+    assert len(nacks) == 1
+    assert "gap" in nacks[0].message
+
+
+def test_stale_refseq_nacked():
+    server = LocalServer()
+    c1, _, nacks1, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    c1.submit([op(1, 2)])
+    c2.submit([op(1, 2)])
+    # both clients' refSeq floor is 2 now; a refSeq below it is nacked
+    c1.submit([op(2, 1)])
+    assert len(nacks1) == 1
+    assert "below msn" in nacks1[0].message
+
+
+def test_expired_client_submission_nacked():
+    # a client evicted by idle expiry (socket still open) gets nacked on
+    # its next submit and must reconnect (ref: deli nack on unknown client)
+    clock = FakeClock()
+    server = LocalServer(clock=clock, client_timeout=60.0)
+    c1, _, nacks, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    clock.now += 120
+    c2.submit([op(1, 1)])  # keeps c2 alive at +120
+    server.expire_idle_clients()  # evicts c1
+    c1.submit([op(1, 1)])
+    assert len(nacks) == 1
+    assert "not connected" in nacks[0].message
+
+
+def test_idle_client_expiry_advances_msn():
+    clock = FakeClock()
+    server = LocalServer(clock=clock, client_timeout=60.0)
+    c1, r1, _, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    c1.submit([op(1, 1)])
+    clock.now += 120  # c1 goes idle; c2 stays active via its op below
+    c2.submit([op(1, 2)])
+    server.expire_idle_clients()
+    deltas = server.get_deltas("t", "d", 0, 100)
+    leaves = [m for m in deltas if m.type == MessageType.CLIENT_LEAVE]
+    assert [m.contents["clientId"] for m in leaves] == [c1.client_id]
+    # with c1 gone the msn is no longer pinned at its refSeq of 1
+    assert deltas[-1].minimum_sequence_number == 2
+
+
+def test_idle_expiry_only_hits_stale_clients():
+    clock = FakeClock()
+    server = LocalServer(clock=clock, client_timeout=60.0)
+    c1, _, _, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    c1.submit([op(1, 1)])
+    clock.now += 120
+    c2.submit([op(1, 2)])  # c2 active at +120
+    clock.now += 10
+    server.expire_idle_clients()
+    deltas = server.get_deltas("t", "d", 0, 100)
+    leaves = [m for m in deltas if m.type == MessageType.CLIENT_LEAVE]
+    assert [m.contents["clientId"] for m in leaves] == [c1.client_id]
+
+
+def test_scriptorium_backfill_window():
+    server = LocalServer()
+    c1, _, _, _ = make_client(server)
+    for i in range(5):
+        c1.submit([op(i + 1, 1, {"i": i})])
+    deltas = server.get_deltas("t", "d", 2, 5)  # exclusive bounds
+    assert [m.sequence_number for m in deltas] == [3, 4]
+
+
+def test_deli_restart_from_checkpoint_resumes_sequencing():
+    server = LocalServer()
+    c1, r1, _, _ = make_client(server)
+    c1.submit([op(1, 1)])
+    seq_before = server._orderers["t/d"].deli.sequence_number
+    seen_before = len(r1)
+
+    server.restart_orderer("t", "d")
+    orderer2 = server._orderers["t/d"]
+    assert orderer2.deli.sequence_number == seq_before
+    assert c1.client_id in orderer2.deli.clients
+    # replay of already-ticketed raw messages is skipped by log offset,
+    # and the new broadcaster must not re-deliver history to live clients
+    before = server.log.length(orderer2.deltas_topic)
+    server.drain()
+    assert server.log.length(orderer2.deltas_topic) == before
+    assert len(r1) == seen_before
+    # new ops continue the sequence with no gap or dup, delivered once
+    c1.submit([op(2, 2)])
+    deltas = server.get_deltas("t", "d", 0, 100)
+    seqs = [m.sequence_number for m in deltas]
+    assert seqs == list(range(1, len(seqs) + 1))
+    assert len(r1) == seen_before + 1
+
+
+def test_signals_relayed_unsequenced():
+    server = LocalServer()
+    c1, _, _, s1 = make_client(server)
+    c2, _, _, s2 = make_client(server)
+    c1.submit_signal({"cursor": 7})
+    assert [s.content for s in s1] == [{"cursor": 7}]
+    assert [s.content for s in s2] == [{"cursor": 7}]
+    assert s1[0].client_id == c1.client_id
+    # signals never hit the op log
+    assert server.get_deltas("t", "d", 0, 100)[-1].type == MessageType.CLIENT_JOIN
+
+
+def test_manual_drain_controls_interleaving():
+    server = LocalServer(auto_drain=False)
+    c1 = server.connect("t", "d")
+    received = []
+    c1.on_op = received.append
+    assert received == []  # nothing delivered yet
+    server.drain()
+    assert [m.type for m in received] == [MessageType.CLIENT_JOIN]
+    c1.submit([op(1, 1, {"a": 1})])
+    c1.submit([op(2, 1, {"a": 2})])
+    assert len(received) == 1
+    server.drain()
+    assert [m.contents for m in received[1:]] == [{"a": 1}, {"a": 2}]
+
+
+def test_independent_documents_have_independent_orders():
+    server = LocalServer()
+    ca = server.connect("t", "docA")
+    cb = server.connect("t", "docB")
+    ra, rb = [], []
+    ca.on_op = ra.append
+    cb.on_op = rb.append
+    ca.submit([op(1, 1)])
+    cb.submit([op(1, 1)])
+    assert server.get_deltas("t", "docA", 0, 100)[-1].sequence_number == 2
+    assert server.get_deltas("t", "docB", 0, 100)[-1].sequence_number == 2
+    assert all(m.sequence_number <= 2 for m in ra)
