@@ -1,0 +1,21 @@
+"""DDS layer: the distributed data structures (all merge logic lives here).
+
+Ref: packages/dds (SURVEY §2.2) — every DDS is a deterministic state
+machine over (snapshot, sequenced op stream) implementing the SharedObject
+contract (shared-object-base/src/sharedObject.ts): optimistic local apply,
+remote apply, own-op ack, reconnect resubmit, snapshot/load.
+"""
+
+from .shared_object import SharedObject
+from .registry import create_channel, load_channel, register_channel_type
+from .string import SharedString
+from .map import SharedMap
+
+__all__ = [
+    "SharedObject",
+    "SharedString",
+    "SharedMap",
+    "create_channel",
+    "load_channel",
+    "register_channel_type",
+]

@@ -1,0 +1,124 @@
+"""SharedMap: LWW key-value store with optimistic local ops.
+
+Ref: packages/dds/map/src/mapKernel.ts:141 — local set/delete/clear apply
+immediately; remote ops for a key are IGNORED while a local op on that key
+is in flight (the local op is later in the total order, so it wins
+everywhere once sequenced: tryProcessMessage :515). Clear has its own
+pending count; acks decrement (trySubmitMessage :498). Values must be
+JSON-serializable; DDS handles are a framework-layer concern.
+
+Wire ops: {"op": "set", "key", "value"} | {"op": "delete", "key"}
+| {"op": "clear"}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+from ..protocol.messages import SequencedDocumentMessage
+from .registry import register_channel_type
+from .shared_object import SharedObject
+
+
+@register_channel_type
+class SharedMap(SharedObject):
+    channel_type = "shared-map"
+
+    def __init__(self, channel_id: str):
+        super().__init__(channel_id)
+        self._data: dict[str, Any] = {}
+        self._pending_keys: dict[str, int] = {}  # key → in-flight local ops
+        self._pending_clear_count = 0
+        self._pending_ops: list[dict] = []  # FIFO, for ack + resubmit
+
+    # ----------------------------------------------------------- mutators
+
+    def set(self, key: str, value: Any) -> None:
+        self._data[key] = value
+        self._submit_map_op({"op": "set", "key": key, "value": value})
+        self._emit("valueChanged", {"key": key, "local": True})
+
+    def delete(self, key: str) -> bool:
+        existed = key in self._data
+        self._data.pop(key, None)
+        self._submit_map_op({"op": "delete", "key": key})
+        self._emit("valueChanged", {"key": key, "local": True})
+        return existed
+
+    def clear(self) -> None:
+        self._data.clear()
+        self._pending_clear_count += 1
+        self._pending_ops.append({"op": "clear"})
+        self.submit_local_message({"op": "clear"})
+        self._emit("clear", {"local": True})
+
+    def _submit_map_op(self, op: dict) -> None:
+        self._pending_keys[op["key"]] = self._pending_keys.get(op["key"], 0) + 1
+        self._pending_ops.append(op)
+        self.submit_local_message(op)
+
+    # ------------------------------------------------------------ readers
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def has(self, key: str) -> bool:
+        return key in self._data
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._data.keys())
+
+    def items(self):
+        return self._data.items()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    # ----------------------------------------------------------- contract
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        op = msg.contents
+        if local:
+            # our own op came back: clear its pending mark; state applied
+            # optimistically already
+            head = self._pending_ops.pop(0)
+            if head["op"] == "clear":
+                self._pending_clear_count -= 1
+            else:
+                key = head["key"]
+                self._pending_keys[key] -= 1
+                if self._pending_keys[key] == 0:
+                    del self._pending_keys[key]
+            return
+
+        if op["op"] == "clear":
+            # a remote clear wipes acked state but keeps our optimistic
+            # pending values (they resequence after the clear)
+            if self._pending_keys:
+                survivors = {k: v for k, v in self._data.items()
+                             if k in self._pending_keys}
+                self._data = survivors
+            else:
+                self._data.clear()
+            self._emit("clear", {"local": False})
+            return
+
+        key = op["key"]
+        if self._pending_clear_count > 0 or key in self._pending_keys:
+            return  # our in-flight op is later in the total order: it wins
+        if op["op"] == "set":
+            self._data[key] = op["value"]
+        else:
+            self._data.pop(key, None)
+        self._emit("valueChanged", {"key": key, "local": False})
+
+    def resubmit_pending(self) -> None:
+        # LWW values carry no positions: resubmit verbatim, same order
+        for op in self._pending_ops:
+            self.submit_local_message(op)
+
+    def snapshot(self) -> dict:
+        return {"data": dict(self._data)}
+
+    def load_core(self, snap: dict) -> None:
+        self._data = dict(snap.get("data", {}))

@@ -1,0 +1,90 @@
+"""SharedString: collaborative text over the merge-tree CRDT.
+
+Ref: packages/dds/sequence/src/sharedString.ts (insertText :152) +
+sequence.ts SharedSegmentSequence, which bridges the merge-tree Client to
+the channel contract. The heavy lifting — optimistic apply, remote
+perspective resolution, ack, reconnect rebase — is MergeTreeClient
+(mergetree/client.py, the scalar oracle; the batched TPU path applies the
+same sequenced stream server-side via ops/apply.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..mergetree.client import MergeTreeClient
+from ..mergetree.ops import op_to_wire
+from ..mergetree.references import LocalReference, ReferenceType
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+from .registry import register_channel_type
+from .shared_object import SharedObject
+
+DETACHED_ID = "detached"
+
+
+@register_channel_type
+class SharedString(SharedObject):
+    channel_type = "shared-string"
+
+    def __init__(self, channel_id: str):
+        super().__init__(channel_id)
+        self.client = MergeTreeClient(DETACHED_ID)
+
+    # ------------------------------------------------------------- editing
+
+    def insert_text(self, pos: int, text: str, props: Optional[dict] = None) -> None:
+        op = self.client.insert_text_local(pos, text, props)
+        self.submit_local_message(op_to_wire(op))
+        self._emit("sequenceDelta", {"op": "insert", "pos": pos, "text": text,
+                                     "local": True})
+
+    def insert_marker(self, pos: int, marker: dict, props: Optional[dict] = None) -> None:
+        op = self.client.insert_marker_local(pos, marker, props)
+        self.submit_local_message(op_to_wire(op))
+
+    def remove_text(self, start: int, end: int) -> None:
+        op = self.client.remove_range_local(start, end)
+        self.submit_local_message(op_to_wire(op))
+        self._emit("sequenceDelta", {"op": "remove", "start": start, "end": end,
+                                     "local": True})
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        op = self.client.annotate_range_local(start, end, props)
+        self.submit_local_message(op_to_wire(op))
+
+    # ------------------------------------------------------------- queries
+
+    def get_text(self) -> str:
+        return self.client.get_text()
+
+    def __len__(self) -> int:
+        return self.client.get_length()
+
+    def create_reference(
+        self, pos: int, ref_type: int = ReferenceType.SLIDE_ON_REMOVE
+    ) -> LocalReference:
+        return self.client.create_reference(pos, ref_type)
+
+    def reference_position(self, ref: LocalReference) -> int:
+        return self.client.reference_position(ref)
+
+    # ------------------------------------------------------------ contract
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        self.client.apply_msg(msg, local)
+        if not local and msg.type == MessageType.OPERATION:
+            self._emit("sequenceDelta", {"wire": msg.contents, "local": False})
+
+    def resubmit_pending(self) -> None:
+        for op in self.client.regenerate_pending_ops():
+            self.submit_local_message(op_to_wire(op))
+
+    def on_connect(self, client_id: str) -> None:
+        if client_id != self.client.client_id:
+            self.client.update_client_id(client_id)
+
+    def snapshot(self) -> dict:
+        return self.client.snapshot()
+
+    def load_core(self, snap: dict) -> None:
+        self.client = MergeTreeClient.load(DETACHED_ID, snap)

@@ -1,0 +1,31 @@
+"""Driver layer: the pluggable boundary between client stack and service.
+
+Ref: packages/loader/driver-definitions + packages/drivers (SURVEY §2.5).
+A document service exposes three sub-services (driver-definitions):
+
+- delta connection  — the live op stream (socket analog)
+- delta storage     — sequenced-op backfill (REST /deltas analog)
+- storage           — snapshots/blobs (historian/git analog)
+
+``local`` binds them straight to an in-proc LocalServer (the local-driver
+test backbone, packages/drivers/local-driver). Production drivers (gRPC
+front end over DCN) implement the same surface.
+"""
+
+from .definitions import (
+    DocumentDeltaConnection,
+    DocumentDeltaStorage,
+    DocumentService,
+    DocumentServiceFactory,
+    DocumentStorage,
+)
+from .local import LocalDocumentServiceFactory
+
+__all__ = [
+    "DocumentDeltaConnection",
+    "DocumentDeltaStorage",
+    "DocumentService",
+    "DocumentServiceFactory",
+    "DocumentStorage",
+    "LocalDocumentServiceFactory",
+]

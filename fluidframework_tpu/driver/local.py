@@ -1,0 +1,138 @@
+"""Local driver: client stack ⇄ in-proc LocalServer, no network.
+
+Ref: packages/drivers/local-driver (localDocumentService.ts,
+localDocumentDeltaConnection.ts) — the test backbone binding the REAL
+client stack to the REAL service lambdas in one process (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Optional
+
+from ..service.local_server import LocalServer, ServerConnection
+from .definitions import (
+    DocumentDeltaConnection,
+    DocumentDeltaStorage,
+    DocumentService,
+    DocumentServiceFactory,
+    DocumentStorage,
+)
+
+
+class LocalDeltaConnection(DocumentDeltaConnection):
+    def __init__(self, conn: ServerConnection):
+        self._conn = conn
+        self.client_id = conn.client_id
+        self.initial_sequence_number = conn.initial_sequence_number
+        self.on_disconnect = None
+
+    # event callbacks proxy straight to the server connection's buffered
+    # handler slots
+    on_op = property(
+        lambda self: self._conn.on_op,
+        lambda self, cb: setattr(self._conn, "on_op", cb))
+    on_nack = property(
+        lambda self: self._conn.on_nack,
+        lambda self, cb: setattr(self._conn, "on_nack", cb))
+    on_signal = property(
+        lambda self: self._conn.on_signal,
+        lambda self, cb: setattr(self._conn, "on_signal", cb))
+
+    def submit(self, messages) -> None:
+        self._conn.submit(messages)
+
+    def submit_signal(self, content: Any, type: str = "signal") -> None:
+        self._conn.submit_signal(content, type)
+
+    def close(self) -> None:
+        self._conn.disconnect()
+        if self.on_disconnect:
+            self.on_disconnect("client closed connection")
+
+
+class LocalDeltaStorage(DocumentDeltaStorage):
+    def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
+        self._server = server
+        self._tenant = tenant_id
+        self._doc = document_id
+
+    def get_deltas(self, from_seq: int, to_seq: int):
+        return self._server.get_deltas(self._tenant, self._doc, from_seq, to_seq)
+
+
+class LocalStorage(DocumentStorage):
+    """Content-addressed blob + versioned summary-tree store on the server
+    db (the gitrest/historian analog; trees/blobs keyed by sha, versions =
+    the ref chain)."""
+
+    def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
+        self._db = server.db
+        self._versions_col = f"summary-versions/{tenant_id}/{document_id}"
+        self._blobs_col = "blobs"
+
+    def get_versions(self, count: int = 1) -> list[dict]:
+        versions = sorted(
+            self._db.collection(self._versions_col).values(),
+            key=lambda v: v["n"],
+            reverse=True,
+        )
+        return [{"id": v["_id"], "tree_id": v["tree_id"]} for v in versions[:count]]
+
+    def get_snapshot_tree(self, version: Optional[dict] = None) -> Optional[dict]:
+        if version is None:
+            versions = self.get_versions(1)
+            if not versions:
+                return None
+            version = versions[0]
+        blob = self.read_blob(version["tree_id"])
+        return json.loads(blob.decode())
+
+    def read_blob(self, blob_id: str) -> bytes:
+        doc = self._db.find_one(self._blobs_col, blob_id)
+        if doc is None:
+            raise KeyError(f"unknown blob {blob_id}")
+        return bytes.fromhex(doc["hex"])
+
+    def write_blob(self, content: bytes) -> str:
+        blob_id = hashlib.sha1(content).hexdigest()
+        self._db.upsert(self._blobs_col, blob_id, {"hex": content.hex()})
+        return blob_id
+
+    def upload_summary(self, summary: Any, parent: Optional[str]) -> str:
+        tree_id = self.write_blob(json.dumps(summary).encode())
+        n = len(self._db.collection(self._versions_col))
+        version_id = f"v{n}"
+        self._db.upsert(
+            self._versions_col,
+            version_id,
+            {"n": n, "tree_id": tree_id, "parent": parent},
+        )
+        return version_id
+
+
+class LocalDocumentService(DocumentService):
+    def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
+        self._server = server
+        self._tenant = tenant_id
+        self._doc = document_id
+
+    def connect_to_delta_stream(self, details: Any = None) -> LocalDeltaConnection:
+        return LocalDeltaConnection(self._server.connect(self._tenant, self._doc, details))
+
+    def connect_to_delta_storage(self) -> LocalDeltaStorage:
+        return LocalDeltaStorage(self._server, self._tenant, self._doc)
+
+    def connect_to_storage(self) -> LocalStorage:
+        return LocalStorage(self._server, self._tenant, self._doc)
+
+
+class LocalDocumentServiceFactory(DocumentServiceFactory):
+    def __init__(self, server: LocalServer):
+        self._server = server
+
+    def create_document_service(
+        self, tenant_id: str, document_id: str
+    ) -> LocalDocumentService:
+        return LocalDocumentService(self._server, tenant_id, document_id)

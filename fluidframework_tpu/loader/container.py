@@ -1,0 +1,161 @@
+"""Container: one client's live replica of one document.
+
+Ref: loader/container-loader/src/container.ts — boot (:931): fetch latest
+summary version → load protocol state (:1116, the client-side quorum
+replica via ProtocolOpHandler) → instantiate runtime (:1547) → attach the
+delta stream and catch up. Afterwards every sequenced message flows
+protocol-first, then into the runtime (§3.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..driver.definitions import DocumentService, DocumentServiceFactory
+from ..protocol.consensus import SequencedClient
+from ..protocol.messages import (
+    MessageType,
+    Nack,
+    SequencedDocumentMessage,
+    Signal,
+)
+from ..protocol.quorum import ProtocolOpHandler
+from ..runtime.container_runtime import ContainerRuntime
+from .delta_manager import DeltaManager
+
+
+class Container:
+    def __init__(
+        self,
+        service: DocumentService,
+        runtime_factory: Optional[Callable[["Container"], ContainerRuntime]] = None,
+    ):
+        self._service = service
+        self.storage = service.connect_to_storage()
+        self.delta_manager = DeltaManager(service)
+        self.delta_manager.process_handler = self._process
+        self.delta_manager.connection_handler = self._on_connection_change
+        self.delta_manager.nack_handler = self._on_nack
+        self.delta_manager.signal_handler = self._on_signal
+        self.protocol: Optional[ProtocolOpHandler] = None
+        self.runtime: Optional[ContainerRuntime] = None
+        self._runtime_factory = runtime_factory or (lambda c: ContainerRuntime(c))
+        self.existing = False
+        self.closed = False
+        self.on_signal: Optional[Callable[[Signal], None]] = None
+        self.on_nack: Optional[Callable[[Nack], None]] = None
+        self._base_snapshot: Optional[dict] = None
+        # every client id this container has ever held: ops from a PREVIOUS
+        # connection sequenced before our leave must still count as local
+        # (acks), or pending state double-applies after reconnect
+        self._my_client_ids: set[str] = set()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def load(self, connect: bool = True) -> "Container":
+        """Boot from the latest summary (if any) and connect live."""
+        snapshot = self.storage.get_snapshot_tree()
+        self._base_snapshot = snapshot
+        if snapshot is not None:
+            self.existing = True
+            self.protocol = ProtocolOpHandler.load(snapshot["protocol"])
+            self.delta_manager.last_processed_seq = snapshot["sequence_number"]
+        else:
+            self.protocol = ProtocolOpHandler()
+        self.runtime = self._runtime_factory(self)
+        if snapshot is not None:
+            self.runtime.load_snapshot(snapshot["runtime"])
+        if connect:
+            self.connect()
+        return self
+
+    def connect(self) -> str:
+        client_id = self.delta_manager.connect()
+        # anything sequenced before our join means the document pre-existed
+        if self.delta_manager.last_processed_seq > 1:
+            self.existing = True
+        return client_id
+
+    def disconnect(self) -> None:
+        self.delta_manager.disconnect()
+
+    def reconnect(self) -> str:
+        """Manual reconnect: new connection + pending-op replay
+        (ref: auto-reconnect state machine deltaManager.ts:294,444)."""
+        return self.delta_manager.reconnect()
+
+    def close(self) -> None:
+        self.closed = True
+        self.delta_manager.disconnect()
+
+    # -------------------------------------------------------------- access
+
+    @property
+    def client_id(self) -> Optional[str]:
+        return self.delta_manager.client_id
+
+    @property
+    def connected(self) -> bool:
+        return self.delta_manager.connected
+
+    @property
+    def quorum(self):
+        return self.protocol.quorum
+
+    @property
+    def audience(self) -> dict[str, SequencedClient]:
+        """Connected clients as known through the total order (join/leave)."""
+        return dict(self.protocol.quorum.members)
+
+    def propose(self, key: str, value: Any) -> None:
+        """Submit a quorum proposal (commits when msn passes it with no
+        rejection — protocol-base quorum.ts:67 semantics)."""
+        self.delta_manager.submit(
+            MessageType.PROPOSE, {"key": key, "value": value}
+        )
+
+    def submit_signal(self, content: Any, type: str = "signal") -> None:
+        self.delta_manager.submit_signal(content, type)
+
+    # ------------------------------------------------------------ internal
+
+    def _process(self, msg: SequencedDocumentMessage) -> None:
+        local = msg.client_id in self._my_client_ids
+        self.protocol.process_message(msg, local)
+        if msg.type == MessageType.OPERATION and self.runtime is not None:
+            self.runtime.process(msg, local)
+
+    def _on_connection_change(self, connected: bool, client_id: Optional[str]) -> None:
+        if connected and client_id is not None:
+            self._my_client_ids.add(client_id)
+        if self.runtime is not None:
+            self.runtime.set_connection_state(connected, client_id)
+
+    def _on_nack(self, nack: Nack) -> None:
+        # a nack means our op stream is broken at the server: the recovery
+        # is reconnect + rebase/resubmit (ref: deltaManager nack handling)
+        if self.on_nack:
+            self.on_nack(nack)
+
+    def _on_signal(self, signal: Signal) -> None:
+        if self.on_signal:
+            self.on_signal(signal)
+
+
+class Loader:
+    """Resolves (tenant, document) → loaded Container
+    (ref: loader.ts:142,202 resolve/loadContainer)."""
+
+    def __init__(
+        self,
+        factory: DocumentServiceFactory,
+        runtime_factory: Optional[Callable[[Container], ContainerRuntime]] = None,
+    ):
+        self._factory = factory
+        self._runtime_factory = runtime_factory
+
+    def resolve(
+        self, tenant_id: str, document_id: str, connect: bool = True
+    ) -> Container:
+        service = self._factory.create_document_service(tenant_id, document_id)
+        return Container(service, self._runtime_factory).load(connect)

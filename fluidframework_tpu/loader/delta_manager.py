@@ -1,0 +1,187 @@
+"""DeltaManager: the client op pump.
+
+Ref: loader/container-loader/src/deltaManager.ts — inbound sequenced ops
+with gap detection + reorder buffer and backfill fetch (:1188, :432, :647),
+outbound submission with clientSeq assignment (:583), connect/reconnect
+state machine (:444). Everything is synchronous and deterministic here;
+async pacing (DeltaScheduler time-slicing) is a host-side concern the TPU
+build handles at the batch boundary instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..driver.definitions import DocumentService
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    Nack,
+    SequencedDocumentMessage,
+    Signal,
+)
+
+
+class DeltaManager:
+    """Pumps one document's op stream for one client.
+
+    ``process_handler(msg)`` is called exactly once per sequenced message,
+    in strict sequence order, regardless of delivery order or gaps.
+    """
+
+    def __init__(self, service: DocumentService):
+        self._service = service
+        self._delta_storage = service.connect_to_delta_storage()
+        self.connection = None
+        self._pending_connection = None  # opened, but our join not yet seen
+        self.client_id: Optional[str] = None
+        self.last_processed_seq = 0
+        self.minimum_sequence_number = 0
+        self._client_seq = 0
+        self._reorder: dict[int, SequencedDocumentMessage] = {}
+        self.process_handler: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        self.nack_handler: Optional[Callable[[Nack], None]] = None
+        self.signal_handler: Optional[Callable[[Signal], None]] = None
+        self.connection_handler: Optional[Callable[[bool, Optional[str]], None]] = None
+        self._details: Any = None
+
+    @property
+    def connected(self) -> bool:
+        return self.connection is not None
+
+    # ------------------------------------------------------------ connect
+
+    def connect(self, details: Any = None) -> str:
+        """Open the live stream and backfill pre-subscription history.
+
+        The connection only becomes ACTIVE (connection_handler fires, write
+        path opens) once our own join is processed from the stream — by
+        then every op of a previous incarnation has been sequenced and
+        acked, so pending-op replay cannot duplicate in-flight ops (ref:
+        container.ts treats a connection as pending until the join op
+        round-trips; deli fences old-client ops behind the leave).
+        """
+        if self.connection is not None or self._pending_connection is not None:
+            return self.client_id
+        self._details = details if details is not None else self._details
+        conn = self._service.connect_to_delta_stream(self._details)
+        self._pending_connection = conn
+        conn.on_nack = self._on_nack
+        conn.on_signal = self._on_signal
+        conn.on_disconnect = lambda reason: self._on_disconnect(reason)
+        conn.on_op = self._enqueue  # assigning flushes buffered events
+        # repair any gap between our head and the pre-subscription history;
+        # everything from the handshake on arrives live (incl. our join)
+        self._fetch_missing(upto=conn.initial_sequence_number)
+        return conn.client_id
+
+    def _activate_connection(self) -> None:
+        conn, self._pending_connection = self._pending_connection, None
+        self.connection = conn
+        self.client_id = conn.client_id
+        self._client_seq = 0
+        if self.connection_handler:
+            self.connection_handler(True, self.client_id)
+
+    def disconnect(self, reason: str = "client disconnect") -> None:
+        conn = self.connection or self._pending_connection
+        if conn is None:
+            return
+        was_active = self.connection is not None
+        self.connection = self._pending_connection = None
+        self.client_id = None
+        conn.on_disconnect = None  # avoid re-entrant notification
+        conn.close()
+        if was_active and self.connection_handler:
+            self.connection_handler(False, None)
+
+    def reconnect(self, reason: str = "reconnect") -> str:
+        self.disconnect(reason)
+        return self.connect()
+
+    def _on_disconnect(self, reason: str) -> None:
+        # server-initiated drop: notify; the container decides when to
+        # reconnect (auto-reconnect policy lives above, container.ts:294)
+        was_active = self.connection is not None
+        self.connection = self._pending_connection = None
+        self.client_id = None
+        if was_active and self.connection_handler:
+            self.connection_handler(False, None)
+
+    # ------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        type: MessageType,
+        contents: Any,
+        metadata: Optional[dict] = None,
+    ) -> int:
+        """Send one message on the live connection; returns clientSeq."""
+        if self.connection is None:
+            raise RuntimeError("cannot submit while disconnected")
+        self._client_seq += 1
+        self.connection.submit(
+            [
+                DocumentMessage(
+                    client_sequence_number=self._client_seq,
+                    reference_sequence_number=self.last_processed_seq,
+                    type=type,
+                    contents=contents,
+                    metadata=metadata,
+                )
+            ]
+        )
+        return self._client_seq
+
+    def submit_signal(self, content: Any, type: str = "signal") -> None:
+        if self.connection is None:
+            raise RuntimeError("cannot signal while disconnected")
+        self.connection.submit_signal(content, type)
+
+    # ------------------------------------------------------------ inbound
+
+    def _enqueue(self, msg: SequencedDocumentMessage) -> None:
+        """Strict-order delivery with reorder buffer + gap repair
+        (ref: processInboundMessage deltaManager.ts:1188)."""
+        if msg.sequence_number <= self.last_processed_seq:
+            return  # duplicate
+        self._reorder[msg.sequence_number] = msg
+        self._drain_reorder()
+        if self._reorder:
+            # a gap remains: repair from delta storage
+            self._fetch_missing(upto=min(self._reorder))
+            self._drain_reorder()
+
+    def _drain_reorder(self) -> None:
+        while self.last_processed_seq + 1 in self._reorder:
+            msg = self._reorder.pop(self.last_processed_seq + 1)
+            self.last_processed_seq = msg.sequence_number
+            self.minimum_sequence_number = msg.minimum_sequence_number
+            if self.process_handler:
+                self.process_handler(msg)
+            if (
+                self._pending_connection is not None
+                and msg.type == MessageType.CLIENT_JOIN
+                and (msg.contents or {}).get("clientId")
+                == self._pending_connection.client_id
+            ):
+                # our join round-tripped: the connection goes active AFTER
+                # the quorum learned about us and every earlier op (incl.
+                # a previous incarnation's in-flight ops) was processed
+                self._activate_connection()
+
+    def _fetch_missing(self, upto: int) -> None:
+        """Backfill (last_processed, upto] from delta storage."""
+        if upto <= self.last_processed_seq:
+            return
+        for msg in self._delta_storage.get_deltas(self.last_processed_seq, upto + 1):
+            self._reorder.setdefault(msg.sequence_number, msg)
+        self._drain_reorder()
+
+    def _on_nack(self, nack: Nack) -> None:
+        if self.nack_handler:
+            self.nack_handler(nack)
+
+    def _on_signal(self, signal: Signal) -> None:
+        if self.signal_handler:
+            self.signal_handler(signal)

@@ -72,9 +72,26 @@ class MergeTreeClient:
     def __init__(self, client_id: str):
         self.client_id = client_id
         self._ids: dict[str, int] = {client_id: 0}
+        self._my_ids: set[str] = {client_id}
         self.tree = MergeTree()
         self.local_seq = 0
         self.pending: deque[PendingOp] = deque()
+
+    def update_client_id(self, new_id: str) -> None:
+        """Adopt the client id of a new connection after reconnect.
+
+        All of this replica's identities (old and new) intern to 0, so
+        pending-segment stamps and the local view stay coherent; ops from a
+        PREVIOUS connection that were sequenced before our leave still ack
+        as our own (ref: Client.startOrUpdateCollaboration updates
+        longClientId, client.ts).
+        """
+        self.client_id = new_id
+        self._my_ids.add(new_id)
+        self._ids[new_id] = 0
+
+    def is_own_message(self, client_id: Optional[str]) -> bool:
+        return client_id in self._my_ids
 
     # -- id interning ----------------------------------------------------
     # interned id for server/system-authored stamps (never a local client)
@@ -164,17 +181,23 @@ class MergeTreeClient:
         return op
 
     # -- sequenced message application ----------------------------------
-    def apply_msg(self, msg: SequencedDocumentMessage) -> None:
+    def apply_msg(
+        self, msg: SequencedDocumentMessage, local: Optional[bool] = None
+    ) -> None:
         """Apply one sequenced merge-tree message (op contents on the wire).
 
         Dispatch: our own message → ack the oldest pending op (server
         sequences each client FIFO); otherwise apply remotely at the
         author's perspective. Always advances (seq, minSeq) and compacts.
+
+        ``local`` is the authoritative own-op flag when the caller (the
+        container, which tracks every id it has held) knows it; standalone
+        use falls back to the replica's own id registry.
         """
         if msg.type == MessageType.OPERATION:
             contents = msg.contents
             op = op_from_wire(contents) if isinstance(contents, dict) else contents
-            if msg.client_id == self.client_id:
+            if self.is_own_message(msg.client_id) if local is None else local:
                 self._ack(op, msg.sequence_number)
             else:
                 perspective = Perspective(

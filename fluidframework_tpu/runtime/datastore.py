@@ -1,0 +1,100 @@
+"""DataStoreRuntime: a named collection of channels (DDS instances).
+
+Ref: runtime/datastore/src/dataStoreRuntime.ts:81 — routes channel ops to
+channel contexts (:462,718); channel creation travels as a chanattach op
+with the channel's snapshot (localChannelContext → attach). The channel
+talks back through a ChannelDeltaConnection adapter
+(channelDeltaConnection.ts:10), here a bound submit closure.
+
+Inner envelope format (contents of a "chanop" runtime envelope):
+
+- {"address": channel_id, "contents": wire_op}                channel op
+- {"address": channel_id, "attach": {"type", "snapshot"}}     channel attach
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+from ..dds.registry import create_channel, load_channel
+from ..protocol.messages import SequencedDocumentMessage
+
+
+class DataStoreRuntime:
+    def __init__(self, runtime, ds_id: str, pkg: str = "default"):
+        self.runtime = runtime
+        self.id = ds_id
+        self.pkg = pkg
+        self.channels: dict[str, object] = {}
+
+    # ------------------------------------------------------------ channels
+
+    def create_channel(self, channel_id: str, channel_type: str):
+        """Create a channel locally and announce it (attach op)."""
+        if channel_id in self.channels:
+            raise KeyError(f"channel {channel_id} exists")
+        channel = create_channel(channel_type, channel_id)
+        self._connect_channel(channel)
+        self.channels[channel_id] = channel
+        self.runtime.submit_channel_op(
+            self.id,
+            {
+                "address": channel_id,
+                "attach": {"type": channel_type, "snapshot": channel.snapshot()},
+            },
+        )
+        return channel
+
+    def get_channel(self, channel_id: str):
+        return self.channels[channel_id]
+
+    def _connect_channel(self, channel) -> None:
+        channel._bind(
+            submit=lambda contents: self.runtime.submit_channel_op(
+                self.id, {"address": channel.id, "contents": contents}
+            ),
+            is_connected=lambda: self.runtime.connected,
+        )
+        if self.runtime.connected:
+            channel.set_connection_state(True, self.runtime.client_id)
+
+    # ------------------------------------------------------------- op flow
+
+    def process(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        inner = msg.contents
+        channel_id = inner["address"]
+        if "attach" in inner:
+            if channel_id not in self.channels:
+                attach = inner["attach"]
+                channel = load_channel(attach["type"], channel_id, attach["snapshot"])
+                self._connect_channel(channel)
+                self.channels[channel_id] = channel
+            return
+        channel = self.channels.get(channel_id)
+        if channel is None:
+            raise KeyError(f"op for unknown channel {channel_id} in store {self.id}")
+        channel.process(replace(msg, contents=inner["contents"]), local)
+
+    def resubmit_channel(self, channel_id: str) -> None:
+        self.channels[channel_id].resubmit_pending()
+
+    def set_connection_state(self, connected: bool, client_id: Optional[str]) -> None:
+        for channel in self.channels.values():
+            channel.set_connection_state(connected, client_id)
+
+    # ------------------------------------------------------------ snapshot
+
+    def snapshot(self) -> dict:
+        return {
+            "channels": {
+                cid: {"type": ch.channel_type, "snapshot": ch.snapshot()}
+                for cid, ch in self.channels.items()
+            }
+        }
+
+    def load_snapshot(self, snap: dict) -> None:
+        for cid, entry in snap.get("channels", {}).items():
+            channel = load_channel(entry["type"], cid, entry["snapshot"])
+            self._connect_channel(channel)
+            self.channels[cid] = channel

@@ -1,0 +1,229 @@
+"""End-to-end: real client stack (loader→runtime→DDS) against the real
+service lambdas in one process — the local-driver test backbone.
+
+Ref: packages/test/end-to-end-tests (sharedStringEndToEndTests.spec.ts,
+mapEndToEndTests.spec.ts, opsOnReconnect.spec.ts, container.spec.ts) over
+LocalDeltaConnectionServer (SURVEY §4).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def boot_two(loader, doc="doc"):
+    c1 = loader.resolve("t", doc)
+    c2 = loader.resolve("t", doc)
+    return c1, c2
+
+
+def test_shared_string_two_clients_converge(loader):
+    c1, c2 = boot_two(loader)
+    ds1 = c1.runtime.create_data_store("default")
+    s1 = ds1.create_channel("text", "shared-string")
+    s1.insert_text(0, "hello world")
+
+    # c2 received the attach ops and materialized the channel
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == "hello world"
+
+    s2.insert_text(5, ", brave")
+    s1.remove_text(0, 5)
+    s1.insert_text(0, "HELLO")
+    assert s1.get_text() == s2.get_text() == "HELLO, brave world"
+
+
+def test_shared_string_concurrent_inserts_deterministic(server, loader):
+    # pause delivery to force true concurrency, then drain
+    server._auto_drain = False
+    c1, c2 = boot_two(loader)
+    server.drain()
+    ds1 = c1.runtime.create_data_store("default")
+    server.drain()
+    s1 = ds1.create_channel("text", "shared-string")
+    server.drain()
+    s1.insert_text(0, "base")
+    server.drain()
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    # concurrent edits at the same position
+    s1.insert_text(0, "AA")
+    s2.insert_text(0, "BB")
+    server.drain()
+    assert s1.get_text() == s2.get_text()
+    assert sorted([s1.get_text().count("AA"), s1.get_text().count("BB")]) == [1, 1]
+
+
+def test_shared_map_converges_and_pending_local_wins(server, loader):
+    c1, c2 = boot_two(loader)
+    m1 = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    m2 = c2.runtime.get_data_store("default").get_channel("kv")
+    m1.set("a", 1)
+    assert m2.get("a") == 1
+
+    server._auto_drain = False
+    m1.set("x", "from-1")
+    m2.set("x", "from-2")
+    server.drain()
+    # both sequenced; the later one in the total order wins everywhere
+    assert m1.get("x") == m2.get("x") == "from-2"
+
+
+def test_shared_map_remote_clear_preserves_pending(server, loader):
+    c1, c2 = boot_two(loader)
+    m1 = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    m2 = c2.runtime.get_data_store("default").get_channel("kv")
+    m1.set("a", 1)
+    server._auto_drain = False
+    m2.clear()
+    m1.set("b", 2)  # in flight when the clear lands
+    server.drain()
+    assert m1.get("a") is None and m2.get("a") is None
+    assert m1.get("b") == m2.get("b") == 2
+
+
+def test_late_joiner_catches_up_from_op_history(loader):
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s1.insert_text(0, "written before client 2 existed")
+    c2 = loader.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == "written before client 2 existed"
+    assert c2.existing
+
+
+def test_reconnect_resubmits_pending_string_ops(server, loader):
+    c1, c2 = boot_two(loader)
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s1.insert_text(0, "shared ")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+
+    c1.disconnect()
+    s1.insert_text(len(s1.get_text()), "offline-edit")  # buffered, not sent
+    s2.insert_text(0, "remote ")  # sequenced while c1 is away
+    assert "offline-edit" not in s2.get_text()
+    c1.reconnect()
+    assert s1.get_text() == s2.get_text() == "remote shared offline-edit"
+
+
+def test_reconnect_resubmits_pending_map_ops(server, loader):
+    c1, c2 = boot_two(loader)
+    m1 = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    m2 = c2.runtime.get_data_store("default").get_channel("kv")
+    c1.disconnect()
+    m1.set("offline", True)
+    m2.set("online", True)
+    c1.reconnect()
+    for m in (m1, m2):
+        assert m.get("offline") is True and m.get("online") is True
+
+
+def test_ops_in_flight_at_disconnect_are_not_duplicated(server, loader):
+    # op reaches the server, client drops BEFORE seeing the ack, reconnects:
+    # catch-up must ack it as our own (old client id), not re-apply or
+    # resubmit it (the double-apply hazard SURVEY §5.3 reconnect rebase)
+    server._auto_drain = False
+    c1, c2 = boot_two(loader)
+    server.drain()
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    server.drain()
+    s1.insert_text(0, "x")
+    # the op is queued server-side; sequence it but do NOT deliver yet:
+    # c1 drops first
+    c1.disconnect()
+    server.drain()
+    c1.reconnect()
+    server.drain()
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s1.get_text() == s2.get_text() == "x"
+
+
+def test_channel_created_offline_attaches_on_reconnect(server, loader):
+    c1, c2 = boot_two(loader)
+    ds1 = c1.runtime.create_data_store("default")
+    c1.disconnect()
+    kv = ds1.create_channel("kv2", "shared-map")  # attach op is pending
+    kv.set("a", 1)
+    c1.reconnect()
+    m2 = c2.runtime.get_data_store("default").get_channel("kv2")
+    assert m2.get("a") == 1
+
+
+def test_reconnect_before_inflight_op_is_sequenced(server, loader):
+    # op still QUEUED (unsequenced) server-side when the client reconnects:
+    # the old copy sequences before our new join, the replay fence must
+    # ack it instead of resubmitting a duplicate
+    server._auto_drain = False
+    c1, c2 = boot_two(loader)
+    server.drain()
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    server.drain()
+    s1.insert_text(0, "x")  # queued in the raw log, NOT sequenced yet
+    c1.disconnect()
+    c1.reconnect()
+    server.drain()  # sequences: old insert, leave, join — then replay runs
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s1.get_text() == s2.get_text() == "x"
+    assert c1.runtime.pending.count == 0
+
+
+def test_quorum_membership_tracks_joins_and_leaves(loader):
+    c1, c2 = boot_two(loader)
+    assert set(c1.audience) == {c1.client_id, c2.client_id}
+    c2.close()
+    assert set(c1.audience) == {c1.client_id}
+
+
+def test_quorum_proposal_commits_via_msn(loader):
+    c1, c2 = boot_two(loader)
+    c1.propose("code", "pkg@1.0")
+    # proposal commits once msn passes its seq: both clients must speak
+    c1.runtime.create_data_store("a")
+    c2.runtime.create_data_store("b")
+    c1.runtime.create_data_store("c")
+    c2.runtime.create_data_store("d")
+    assert c1.quorum.get("code") == "pkg@1.0"
+    assert c2.quorum.get("code") == "pkg@1.0"
+
+
+def test_boot_from_snapshot_plus_tail(server, loader):
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s1.insert_text(0, "summarized")
+    # write a summary by hand (the summarizer subsystem automates this)
+    summary = {
+        "protocol": c1.protocol.snapshot(),
+        "runtime": c1.runtime.snapshot(),
+        "sequence_number": c1.delta_manager.last_processed_seq,
+    }
+    c1.storage.upload_summary(summary, parent=None)
+    # more ops after the summary → the tail
+    s1.insert_text(0, "tail ")
+
+    c3 = loader.resolve("t", "doc")
+    s3 = c3.runtime.get_data_store("default").get_channel("text")
+    assert s3.get_text() == "tail summarized"
+    assert c3.existing
+    # and the booted replica is live: new edits converge both ways
+    s3.insert_text(0, "c3 ")
+    s1.insert_text(len(s1.get_text()), " end")
+    assert s1.get_text() == s3.get_text() == "c3 tail summarized end"
+
+
+def test_signals_between_containers(loader):
+    c1, c2 = boot_two(loader)
+    got = []
+    c2.on_signal = lambda sig: got.append((sig.client_id, sig.content))
+    c1.submit_signal({"presence": "typing"})
+    assert got == [(c1.client_id, {"presence": "typing"})]
