@@ -10,11 +10,24 @@ from .shared_object import SharedObject
 from .registry import create_channel, load_channel, register_channel_type
 from .string import SharedString
 from .map import SharedMap
+from .cell import SharedCell, SharedCounter
+from .directory import SharedDirectory
+from .consensus import ConsensusQueue, ConsensusRegisterCollection
+from .ink import Ink, SharedSummaryBlock
+from .matrix import SharedMatrix
 
 __all__ = [
     "SharedObject",
     "SharedString",
     "SharedMap",
+    "SharedCell",
+    "SharedCounter",
+    "SharedDirectory",
+    "ConsensusQueue",
+    "ConsensusRegisterCollection",
+    "Ink",
+    "SharedSummaryBlock",
+    "SharedMatrix",
     "create_channel",
     "load_channel",
     "register_channel_type",

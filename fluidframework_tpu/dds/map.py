@@ -1,11 +1,8 @@
 """SharedMap: LWW key-value store with optimistic local ops.
 
-Ref: packages/dds/map/src/mapKernel.ts:141 — local set/delete/clear apply
-immediately; remote ops for a key are IGNORED while a local op on that key
-is in flight (the local op is later in the total order, so it wins
-everywhere once sequenced: tryProcessMessage :515). Clear has its own
-pending count; acks decrement (trySubmitMessage :498). Values must be
-JSON-serializable; DDS handles are a framework-layer concern.
+Ref: packages/dds/map/src/map.ts over mapKernel.ts:141 — the kernel logic
+lives in map_kernel.MapKernel, shared with SharedDirectory exactly as the
+reference shares mapKernel.ts.
 
 Wire ops: {"op": "set", "key", "value"} | {"op": "delete", "key"}
 | {"op": "clear"}.
@@ -13,9 +10,10 @@ Wire ops: {"op": "set", "key", "value"} | {"op": "delete", "key"}
 
 from __future__ import annotations
 
-from typing import Any, Iterator, Optional
+from typing import Any, Iterator
 
 from ..protocol.messages import SequencedDocumentMessage
+from .map_kernel import MapKernel
 from .registry import register_channel_type
 from .shared_object import SharedObject
 
@@ -26,91 +24,60 @@ class SharedMap(SharedObject):
 
     def __init__(self, channel_id: str):
         super().__init__(channel_id)
-        self._data: dict[str, Any] = {}
-        self._pending_keys: dict[str, int] = {}  # key → in-flight local ops
-        self._pending_clear_count = 0
+        self._kernel = MapKernel()
         self._pending_ops: list[dict] = []  # FIFO, for ack + resubmit
 
     # ----------------------------------------------------------- mutators
 
     def set(self, key: str, value: Any) -> None:
-        self._data[key] = value
+        self._kernel.local_set(key, value)
         self._submit_map_op({"op": "set", "key": key, "value": value})
         self._emit("valueChanged", {"key": key, "local": True})
 
     def delete(self, key: str) -> bool:
-        existed = key in self._data
-        self._data.pop(key, None)
+        existed = self._kernel.local_delete(key)
         self._submit_map_op({"op": "delete", "key": key})
         self._emit("valueChanged", {"key": key, "local": True})
         return existed
 
     def clear(self) -> None:
-        self._data.clear()
-        self._pending_clear_count += 1
-        self._pending_ops.append({"op": "clear"})
-        self.submit_local_message({"op": "clear"})
+        self._kernel.local_clear()
+        self._submit_map_op({"op": "clear"})
         self._emit("clear", {"local": True})
 
     def _submit_map_op(self, op: dict) -> None:
-        self._pending_keys[op["key"]] = self._pending_keys.get(op["key"], 0) + 1
         self._pending_ops.append(op)
         self.submit_local_message(op)
 
     # ------------------------------------------------------------ readers
 
     def get(self, key: str, default: Any = None) -> Any:
-        return self._data.get(key, default)
+        return self._kernel.get(key, default)
 
     def has(self, key: str) -> bool:
-        return key in self._data
+        return self._kernel.has(key)
 
     def keys(self) -> Iterator[str]:
-        return iter(self._data.keys())
+        return self._kernel.keys()
 
     def items(self):
-        return self._data.items()
+        return self._kernel.data.items()
 
     def __len__(self) -> int:
-        return len(self._data)
+        return len(self._kernel.data)
 
     # ----------------------------------------------------------- contract
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
-        op = msg.contents
         if local:
-            # our own op came back: clear its pending mark; state applied
-            # optimistically already
-            head = self._pending_ops.pop(0)
-            if head["op"] == "clear":
-                self._pending_clear_count -= 1
-            else:
-                key = head["key"]
-                self._pending_keys[key] -= 1
-                if self._pending_keys[key] == 0:
-                    del self._pending_keys[key]
+            self._kernel.ack(self._pending_ops.pop(0))
             return
-
-        if op["op"] == "clear":
-            # a remote clear wipes acked state but keeps our optimistic
-            # pending values (they resequence after the clear)
-            if self._pending_keys:
-                survivors = {k: v for k, v in self._data.items()
-                             if k in self._pending_keys}
-                self._data = survivors
+        op = msg.contents
+        if self._kernel.apply_remote(op):
+            if op["op"] == "clear":
+                self._emit("clear", {"local": False})
             else:
-                self._data.clear()
-            self._emit("clear", {"local": False})
-            return
-
-        key = op["key"]
-        if self._pending_clear_count > 0 or key in self._pending_keys:
-            return  # our in-flight op is later in the total order: it wins
-        if op["op"] == "set":
-            self._data[key] = op["value"]
-        else:
-            self._data.pop(key, None)
-        self._emit("valueChanged", {"key": key, "local": False})
+                self._emit("valueChanged", {"key": op["key"], "local": False})
 
     def resubmit_pending(self) -> None:
         # LWW values carry no positions: resubmit verbatim, same order
@@ -118,7 +85,7 @@ class SharedMap(SharedObject):
             self.submit_local_message(op)
 
     def snapshot(self) -> dict:
-        return {"data": dict(self._data)}
+        return {"data": dict(self._kernel.data)}
 
     def load_core(self, snap: dict) -> None:
-        self._data = dict(snap.get("data", {}))
+        self._kernel.data = dict(snap.get("data", {}))

@@ -122,8 +122,16 @@ class Container:
     def _process(self, msg: SequencedDocumentMessage) -> None:
         local = msg.client_id in self._my_client_ids
         self.protocol.process_message(msg, local)
-        if msg.type == MessageType.OPERATION and self.runtime is not None:
+        if self.runtime is None:
+            return
+        if msg.type == MessageType.OPERATION:
             self.runtime.process(msg, local)
+        elif msg.type == MessageType.CLIENT_LEAVE:
+            # consensus collections release a leaver's holdings
+            # deterministically off the sequenced leave (SURVEY §2.2)
+            left = (msg.contents or {}).get("clientId")
+            if left:
+                self.runtime.on_member_removed(left)
 
     def _on_connection_change(self, connected: bool, client_id: Optional[str]) -> None:
         if connected and client_id is not None:

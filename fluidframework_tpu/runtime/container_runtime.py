@@ -122,6 +122,10 @@ class ContainerRuntime:
                 MessageType.OPERATION, envelope
             )
 
+    def on_member_removed(self, client_id: str) -> None:
+        for ds in self.data_stores.values():
+            ds.on_member_removed(client_id)
+
     # ----------------------------------------------------------- reconnect
 
     def set_connection_state(self, connected: bool, client_id: Optional[str]) -> None:

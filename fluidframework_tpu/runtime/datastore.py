@@ -83,6 +83,12 @@ class DataStoreRuntime:
         for channel in self.channels.values():
             channel.set_connection_state(connected, client_id)
 
+    def on_member_removed(self, client_id: str) -> None:
+        for channel in self.channels.values():
+            handler = getattr(channel, "on_member_removed", None)
+            if handler:
+                handler(client_id)
+
     # ------------------------------------------------------------ snapshot
 
     def snapshot(self) -> dict:

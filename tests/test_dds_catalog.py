@@ -1,0 +1,355 @@
+"""DDS catalog end-to-end specs: cell, counter, directory, consensus
+register/queue, ink, summary block, matrix.
+
+Ref test model: packages/test/end-to-end-tests one spec file per DDS
+(SURVEY §4), run against the in-proc service.
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def pair(loader, channel_type, doc="doc", name="ch"):
+    c1 = loader.resolve("t", doc)
+    c2 = loader.resolve("t", doc)
+    d1 = c1.runtime.create_data_store("default").create_channel(name, channel_type)
+    d2 = c2.runtime.get_data_store("default").get_channel(name)
+    return c1, c2, d1, d2
+
+
+# ----------------------------------------------------------------- cell
+
+def test_cell_lww_and_pending_mask(server, loader):
+    c1, c2, a, b = pair(loader, "shared-cell")
+    a.set(1)
+    assert b.get() == 1
+    server._auto_drain = False
+    b.set(2)
+    a.set(3)  # later in total order → wins everywhere
+    server.drain()
+    assert a.get() == b.get() == 3
+    a.delete()
+    server.drain()
+    assert b.empty
+
+
+# -------------------------------------------------------------- counter
+
+def test_counter_commutative_increments(server, loader):
+    c1, c2, a, b = pair(loader, "shared-counter")
+    server._auto_drain = False
+    a.increment(5)
+    b.increment(-2)
+    a.increment(1)
+    server.drain()
+    assert a.value == b.value == 4
+
+
+def test_counter_offline_reconnect(server, loader):
+    c1, c2, a, b = pair(loader, "shared-counter")
+    c1.disconnect()
+    a.increment(10)
+    b.increment(1)
+    c1.reconnect()
+    assert a.value == b.value == 11
+
+
+# ------------------------------------------------------------ directory
+
+def test_directory_subdirs_and_values(server, loader):
+    c1, c2, a, b = pair(loader, "shared-directory")
+    a.set("rootKey", 1)
+    sub = a.create_subdirectory("sub")
+    sub.set("x", "deep")
+    nested = sub.create_subdirectory("nested")
+    nested.set("y", [1, 2])
+    assert b.get("rootKey") == 1
+    assert b.get_working_directory("/sub").get("x") == "deep"
+    assert b.get_working_directory("/sub/nested").get("y") == [1, 2]
+    b.get_working_directory("/sub").delete("x")
+    assert a.get_working_directory("/sub").get("x") is None
+
+
+def test_directory_pending_local_wins(server, loader):
+    c1, c2, a, b = pair(loader, "shared-directory")
+    sub_a = a.create_subdirectory("s")
+    server.drain()
+    sub_b = b.get_subdirectory("s")
+    server._auto_drain = False
+    sub_b.set("k", "b-val")
+    sub_a.set("k", "a-val")  # later in order → wins
+    server.drain()
+    assert sub_a.get("k") == sub_b.get("k") == "a-val"
+
+
+def test_directory_concurrent_delete_recreate_converges(server, loader):
+    c1, c2, a, b = pair(loader, "shared-directory")
+    a.create_subdirectory("x")
+    server.drain()
+    server._auto_drain = False
+    b.delete_subdirectory("x")
+    b.create_subdirectory("x")
+    a.delete_subdirectory("x")  # sequenced last → wins
+    server.drain()
+    assert a.get_subdirectory("x") is None
+    assert b.get_subdirectory("x") is None
+
+
+def test_directory_delete_parent_vs_create_child_converges(server, loader):
+    c1, c2, a, b = pair(loader, "shared-directory")
+    a.create_subdirectory("p")
+    server.drain()
+    server._auto_drain = False
+    b.delete_subdirectory("p")  # sequenced first
+    a.get_subdirectory("p").create_subdirectory("c")
+    server.drain()
+    # the delete killed the subtree; the interior create must not resurrect
+    assert (a.get_working_directory("/p/c") is None) == (
+        b.get_working_directory("/p/c") is None)
+    assert (a.get_subdirectory("p") is None) == (b.get_subdirectory("p") is None)
+
+
+def test_directory_recreate_masks_interior_remote_ops(server, loader):
+    c1, c2, a, b = pair(loader, "shared-directory")
+    a.create_subdirectory("x")
+    server.drain()
+    server._auto_drain = False
+    a.delete_subdirectory("x")
+    a.create_subdirectory("x")  # fresh empty node, both ops in flight
+    b.get_subdirectory("x").set("k", 5)  # sequenced between them
+    server.drain()
+    # a's recreate is last: the subtree is empty on BOTH replicas
+    assert a.get_subdirectory("x").get("k") == b.get_subdirectory("x").get("k")
+
+
+# ---------------------------------------------------- consensus register
+
+def test_register_atomic_first_write_wins(server, loader):
+    c1, c2, a, b = pair(loader, "consensus-register-collection")
+    server._auto_drain = False
+    a.write("leader", c1.client_id)
+    b.write("leader", c2.client_id)
+    server.drain()
+    # both versions coexist (neither writer had seen the other)
+    assert set(a.read_versions("leader")) == {c1.client_id, c2.client_id}
+    # atomic read = first sequenced = consensus winner, same on both
+    assert a.read("leader") == b.read("leader") == c1.client_id
+    assert a.read("leader", "lww") == c2.client_id
+    # a later write that has seen both supersedes them
+    a.write("leader", "final")
+    server.drain()
+    assert b.read_versions("leader") == ["final"]
+
+
+# ------------------------------------------------------- consensus queue
+
+def test_queue_exactly_once_acquire(server, loader):
+    c1, c2, a, b = pair(loader, "consensus-queue")
+    a.add("job1")
+    a.add("job2")
+    server._auto_drain = False
+    a.acquire()
+    b.acquire()
+    server.drain()
+    held_a, held_b = a.holding(c1.client_id), b.holding(c2.client_id)
+    # each job handed to exactly one client, consistently on both replicas
+    assert len(held_a) == 1 and len(held_b) == 1
+    assert {held_a[0][1], held_b[0][1]} == {"job1", "job2"}
+    assert a.holding(c1.client_id) == b.holding(c1.client_id)
+    # complete removes durably
+    item_id = held_a[0][0]
+    a.complete(item_id)
+    server.drain()
+    assert b.holding(c1.client_id) == []
+
+
+def test_queue_release_requeues(server, loader):
+    c1, c2, a, b = pair(loader, "consensus-queue")
+    a.add("job")
+    a.acquire()
+    item_id = a.holding()[0][0]
+    a.release(item_id)
+    assert len(a) == len(b) == 1
+    b.acquire()
+    assert b.holding()[0][1] == "job"
+
+
+def test_queue_holder_leave_requeues(server, loader):
+    c1, c2, a, b = pair(loader, "consensus-queue")
+    a.add("orphan")
+    a.acquire()
+    assert len(b) == 0
+    c1.close()  # leave is sequenced; b sees the requeue
+    assert len(b) == 1
+    b.acquire()
+    assert b.holding()[0][1] == "orphan"
+
+
+# ------------------------------------------------------------------- ink
+
+def test_ink_strokes_converge(server, loader):
+    c1, c2, a, b = pair(loader, "ink")
+    sid = a.create_stroke({"color": "red", "thickness": 2})
+    a.append_point(sid, 0.0, 0.0)
+    a.append_point(sid, 1.0, 1.5)
+    sid2 = b.create_stroke({"color": "blue"})
+    b.append_point(sid2, 5.0, 5.0)
+    for ink in (a, b):
+        strokes = ink.get_strokes()
+        assert len(strokes) == 2
+        assert ink.get_stroke(sid)["points"] == [
+            {"x": 0.0, "y": 0.0}, {"x": 1.0, "y": 1.5}]
+        assert ink.get_stroke(sid2)["pen"] == {"color": "blue"}
+
+
+def test_ink_stroke_order_converges(server, loader):
+    c1, c2, a, b = pair(loader, "ink")
+    server._auto_drain = False
+    s1 = a.create_stroke({"n": 1})
+    s2 = b.create_stroke({"n": 2})
+    server.drain()
+    assert [s["id"] for s in a.get_strokes()] == [s["id"] for s in b.get_strokes()]
+    assert a.snapshot()["order"] == b.snapshot()["order"]
+
+
+def test_ink_snapshot_is_acked_state_only(server, loader):
+    c1, c2, a, b = pair(loader, "ink")
+    sid = a.create_stroke({})
+    a.append_point(sid, 0, 0)
+    server._auto_drain = False
+    b.append_point(sid, 9, 9)  # remote point sequenced before a's pending
+    server.drain()
+    a.append_point(sid, 1, 1)  # pending, unsequenced
+    snap = a.snapshot()
+    # acked: both sequenced points, no pending one
+    assert snap["strokes"][sid]["points"] == [
+        {"x": 0, "y": 0}, {"x": 9, "y": 9}]
+    # live view still shows the optimistic point at the end
+    assert a.get_stroke(sid)["points"][-1] == {"x": 1, "y": 1}
+
+
+# ---------------------------------------------------------------- matrix
+
+def test_matrix_shape_and_cells(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 3)
+    a.set_cell(0, 0, "tl")
+    a.set_cell(1, 2, "br")
+    assert (b.row_count, b.col_count) == (2, 3)
+    assert b.get_cell(0, 0) == "tl" and b.get_cell(1, 2) == "br"
+    assert a.to_lists() == b.to_lists()
+
+
+def test_matrix_concurrent_row_insert_keeps_cells_aligned(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    a.set_cell(1, 1, "anchor")
+    server._auto_drain = False
+    # b inserts a row ABOVE the anchor while a writes to it by position
+    b.insert_rows(0, 1)
+    a.set_cell(1, 1, "updated")
+    server.drain()
+    # the anchor row slid to index 2; the positional write still hit it
+    assert a.to_lists() == b.to_lists()
+    assert a.get_cell(2, 1) == "updated"
+    assert (a.row_count, a.col_count) == (3, 2)
+
+
+def test_matrix_concurrent_cell_write_lww(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    server._auto_drain = False
+    a.set_cell(0, 0, "from-a")
+    b.set_cell(0, 0, "from-b")
+    server.drain()
+    assert a.get_cell(0, 0) == b.get_cell(0, 0) == "from-b"
+
+
+def test_matrix_remove_rows(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 1)
+    for r in range(3):
+        a.set_cell(r, 0, f"r{r}")
+    a.remove_rows(1, 1)
+    assert b.row_count == 2
+    assert [b.get_cell(r, 0) for r in range(2)] == ["r0", "r2"]
+    assert a.to_lists() == b.to_lists()
+
+
+def test_matrix_offline_edits_rebase(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    a.set_cell(0, 0, "base")
+    c1.disconnect()
+    a.insert_rows(1, 1)
+    a.set_cell(1, 0, "offline")
+    b.insert_rows(0, 1)  # lands before reconnect
+    c1.reconnect()
+    assert a.to_lists() == b.to_lists()
+    assert a.get_cell(2, 0) == "offline"  # slid down by b's insert
+
+
+def test_matrix_snapshot_boot(server, loader):
+    c1 = loader.resolve("t", "doc")
+    m = c1.runtime.create_data_store("default").create_channel("m", "shared-matrix")
+    m.insert_rows(0, 2)
+    m.insert_cols(0, 2)
+    m.set_cell(0, 1, 42)
+    summary = {"protocol": c1.protocol.snapshot(),
+               "runtime": c1.runtime.snapshot(),
+               "sequence_number": c1.delta_manager.last_processed_seq}
+    c1.storage.upload_summary(summary, parent=None)
+    c3 = loader.resolve("t", "doc")
+    m3 = c3.runtime.get_data_store("default").get_channel("m")
+    assert m3.get_cell(0, 1) == 42
+    m3.set_cell(1, 1, "post-boot")
+    assert m.get_cell(1, 1) == "post-boot"
+
+
+def test_matrix_removed_rows_purge_cell_storage(server, loader):
+    c1, c2, a, b = pair(loader, "shared-matrix")
+    a.insert_cols(0, 1)
+    for round_ in range(5):
+        a.insert_rows(0, 2)
+        a.set_cell(0, 0, f"v{round_}")
+        a.set_cell(1, 0, f"w{round_}")
+        a.remove_rows(0, 2)
+    assert a.row_count == b.row_count == 0
+    # the sparse store must not accumulate dead cells on either replica
+    assert len(a._cells) == 0
+    assert len(b._cells) == 0
+    assert a.snapshot()["cells"] == []
+
+
+# -------------------------------------------------------- summary block
+
+def test_summary_block_travels_via_snapshot_only(server, loader):
+    c1 = loader.resolve("t", "doc")
+    sb = c1.runtime.create_data_store("default").create_channel(
+        "sb", "shared-summary-block")
+    sb.set("stats", {"count": 7})
+    summary = {"protocol": c1.protocol.snapshot(),
+               "runtime": c1.runtime.snapshot(),
+               "sequence_number": c1.delta_manager.last_processed_seq}
+    c1.storage.upload_summary(summary, parent=None)
+    c2 = loader.resolve("t", "doc")
+    sb2 = c2.runtime.get_data_store("default").get_channel("sb")
+    assert sb2.get("stats") == {"count": 7}
