@@ -11,6 +11,7 @@ import hashlib
 import json
 from typing import Any, Optional
 
+from ..service.core import summary_versions_collection
 from ..service.local_server import LocalServer, ServerConnection
 from .definitions import (
     DocumentDeltaConnection,
@@ -69,12 +70,16 @@ class LocalStorage(DocumentStorage):
 
     def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
         self._db = server.db
-        self._versions_col = f"summary-versions/{tenant_id}/{document_id}"
+        self._versions_col = summary_versions_collection(tenant_id, document_id)
         self._blobs_col = "blobs"
 
     def get_versions(self, count: int = 1) -> list[dict]:
+        """Only scribe-ACKED versions are boot sources (the git-ref analog:
+        scribe committing a summary is what makes it a version); uploads
+        that were never validated, or were nacked, are invisible here."""
         versions = sorted(
-            self._db.collection(self._versions_col).values(),
+            (v for v in self._db.collection(self._versions_col).values()
+             if v.get("acked")),
             key=lambda v: v["n"],
             reverse=True,
         )

@@ -49,6 +49,8 @@ class Container:
         # connection sequenced before our leave must still count as local
         # (acks), or pending state double-applies after reconnect
         self._my_client_ids: set[str] = set()
+        # subsystems observing the sequenced stream (summarizer, telemetry)
+        self._message_observers: list = []
 
     # ------------------------------------------------------------- lifecycle
 
@@ -119,19 +121,23 @@ class Container:
 
     # ------------------------------------------------------------ internal
 
+    def add_message_observer(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
+        self._message_observers.append(fn)
+
     def _process(self, msg: SequencedDocumentMessage) -> None:
         local = msg.client_id in self._my_client_ids
         self.protocol.process_message(msg, local)
-        if self.runtime is None:
-            return
-        if msg.type == MessageType.OPERATION:
-            self.runtime.process(msg, local)
-        elif msg.type == MessageType.CLIENT_LEAVE:
-            # consensus collections release a leaver's holdings
-            # deterministically off the sequenced leave (SURVEY §2.2)
-            left = (msg.contents or {}).get("clientId")
-            if left:
-                self.runtime.on_member_removed(left)
+        if self.runtime is not None:
+            if msg.type == MessageType.OPERATION:
+                self.runtime.process(msg, local)
+            elif msg.type == MessageType.CLIENT_LEAVE:
+                # consensus collections release a leaver's holdings
+                # deterministically off the sequenced leave (SURVEY §2.2)
+                left = (msg.contents or {}).get("clientId")
+                if left:
+                    self.runtime.on_member_removed(left)
+        for fn in self._message_observers:
+            fn(msg)
 
     def _on_connection_change(self, connected: bool, client_id: Optional[str]) -> None:
         if connected and client_id is not None:

@@ -1,0 +1,131 @@
+"""Client-side summarizer: election + attempt heuristics + ack tracking.
+
+Ref: runtime/container-runtime summarizer subsystem — SummaryManager
+elects the summarizer from the OLDEST quorum member (summaryManager.ts:
+139,269); RunningSummarizer drives attempts off ops-since-last-ack
+heuristics (summarizer.ts:232,403); SummaryCollection correlates the
+broadcast summarize op with its ack/nack (summaryCollection.ts).
+
+Differences from the reference, by design: the reference spawns a hidden
+"/_summarizer" container so the summarizing replica never holds pending
+local ops; here the elected client summarizes in-process and simply
+defers while it has unacked ops (same invariant — summaries capture only
+acked state — without the second container).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+
+DEFAULT_MAX_OPS = 100  # ops since last acked summary that trigger an attempt
+
+
+class SummaryManager:
+    """Attach one per container (`SummaryManager(container)`); it watches
+    the quorum, self-elects when oldest, and summarizes on the heuristics.
+    """
+
+    def __init__(
+        self,
+        container,
+        max_ops: int = DEFAULT_MAX_OPS,
+    ):
+        self.container = container
+        self.max_ops = max_ops
+        self.last_acked_handle: Optional[str] = None
+        self.last_acked_seq = 0
+        self._pending_handle: Optional[str] = None
+        self._ops_since_ack = 0
+        self.summaries_acked = 0
+        self.summaries_nacked = 0
+        # seed the head from storage: a manager attached after boot missed
+        # the SUMMARY_ACKs already in the op tail, and proposing
+        # parent=None against an existing chain would nack-loop forever
+        versions = container.storage.get_versions(1)
+        if versions:
+            self.last_acked_handle = versions[0]["id"]
+            tree = container.storage.get_snapshot_tree(versions[0])
+            if tree:
+                self.last_acked_seq = tree.get("sequence_number", 0)
+        container.add_message_observer(self._observe)
+
+    # ------------------------------------------------------------ election
+
+    @property
+    def elected_summarizer(self) -> Optional[str]:
+        """Oldest quorum member = lowest join sequence number
+        (ref: summaryManager electing via quorum join order)."""
+        members = self.container.quorum.members
+        if not members:
+            return None
+        return min(members.items(), key=lambda kv: kv[1].sequence_number)[0]
+
+    @property
+    def is_summarizer(self) -> bool:
+        return (
+            self.container.client_id is not None
+            and self.elected_summarizer == self.container.client_id
+        )
+
+    # ------------------------------------------------------------ observer
+
+    def _observe(self, msg: SequencedDocumentMessage) -> None:
+        if msg.type == MessageType.SUMMARY_ACK:
+            handle = (msg.contents or {}).get("handle")
+            self.last_acked_handle = handle
+            self.last_acked_seq = (msg.contents or {}).get(
+                "summarySequenceNumber", msg.sequence_number)
+            self._ops_since_ack = 0
+            if handle == self._pending_handle:
+                self._pending_handle = None
+                self.summaries_acked += 1
+            return
+        if msg.type == MessageType.SUMMARY_NACK:
+            # correlate by handle: another client's nack must not clear
+            # OUR in-flight attempt
+            if (msg.contents or {}).get("handle") == self._pending_handle \
+                    and self._pending_handle is not None:
+                self._pending_handle = None
+                self.summaries_nacked += 1
+            return
+        if msg.type == MessageType.OPERATION:
+            self._ops_since_ack += 1
+            self._maybe_summarize()
+
+    def _maybe_summarize(self) -> None:
+        if (
+            self._ops_since_ack < self.max_ops
+            or not self.is_summarizer
+            or self._pending_handle is not None
+            or not self.container.connected
+            # only acked state may be summarized (the reference gets this
+            # invariant from the hidden summarizer container)
+            or self.container.runtime.pending.count > 0
+        ):
+            return
+        self.summarize_now()
+
+    # ------------------------------------------------------------- attempt
+
+    def summarize_now(self) -> Optional[str]:
+        """Generate, upload, and propose a summary (ref:
+        ContainerRuntime.generateSummary containerRuntime.ts:1631 +
+        summarize op submission §3.4)."""
+        if self.container.runtime.pending.count > 0:
+            raise RuntimeError("cannot summarize with pending local ops")
+        summary = {
+            "protocol": self.container.protocol.snapshot(),
+            "runtime": self.container.runtime.snapshot(),
+            "sequence_number": self.container.delta_manager.last_processed_seq,
+        }
+        handle = self.container.storage.upload_summary(
+            summary, parent=self.last_acked_handle)
+        self._pending_handle = handle
+        self.container.delta_manager.submit(
+            MessageType.SUMMARIZE,
+            {"handle": handle, "parent": self.last_acked_handle,
+             "head": summary["sequence_number"]},
+        )
+        return handle

@@ -79,6 +79,12 @@ class CheckpointManager:
         return self._offsets.get((topic, partition), -1)
 
 
+def summary_versions_collection(tenant_id: str, document_id: str) -> str:
+    """Db collection holding a document's summary version chain — shared
+    by the storage driver (upload) and scribe (validation/commit)."""
+    return f"summary-versions/{tenant_id}/{document_id}"
+
+
 @dataclass
 class InMemoryDb:
     """Dict-of-collections store (the Mongo stand-in for tests).

@@ -183,6 +183,12 @@ class DeliLambda:
             self._sequence_system(MessageType.CLIENT_LEAVE, op.contents)
             return
 
+        if raw.client_id is None:
+            # other server-originated messages (scribe's summary ack/nack,
+            # control) sequence without client bookkeeping
+            self._sequence_system(op.type, op.contents)
+            return
+
         # client-originated: must be joined
         client = self.clients.get(raw.client_id)
         if client is None:

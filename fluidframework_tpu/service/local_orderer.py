@@ -17,6 +17,7 @@ from .broadcaster import BroadcasterLambda, PubSub
 from .core import InMemoryDb
 from .deli import DeliCheckpoint, DeliLambda, RawMessage
 from .local_log import LocalLog
+from .scribe import SCRIBE_CHECKPOINT_COLLECTION, ScribeLambda
 from .scriptorium import ScriptoriumLambda
 
 CHECKPOINT_COLLECTION = "deli-checkpoints"
@@ -59,6 +60,15 @@ class LocalOrderer:
         )
         self.scriptorium = ScriptoriumLambda(db)
         self.broadcaster = BroadcasterLambda(pubsub)
+        scribe_cp = db.find_one(
+            SCRIBE_CHECKPOINT_COLLECTION, f"{tenant_id}/{document_id}")
+        self.scribe = ScribeLambda(
+            tenant_id,
+            document_id,
+            db,
+            send_to_deli=self.order,
+            checkpoint=scribe_cp["state"] if scribe_cp else None,
+        )
 
         # deli replays the raw topic from 0 and self-skips via its
         # checkpointed log_offset (crash between append and ticket must
@@ -70,6 +80,7 @@ class LocalOrderer:
         self._subscriptions = [
             (self.raw_topic, self.deli.handler, 0),
             (self.deltas_topic, self.scriptorium.handler, 0),
+            (self.deltas_topic, self.scribe.handler, 0),
             (self.deltas_topic, self.broadcaster.handler, log.length(self.deltas_topic)),
         ]
         for topic, handler, from_offset in self._subscriptions:
@@ -86,12 +97,14 @@ class LocalOrderer:
             self._log.unsubscribe(topic, handler)
 
     def checkpoint(self) -> None:
-        """Persist deli state (ref: checkpointContext.checkpoint → Mongo)."""
+        """Persist deli + scribe state (ref: deli checkpointContext.ts,
+        scribe checkpointManager.ts → Mongo)."""
         self._db.upsert(
             CHECKPOINT_COLLECTION,
             f"{self.tenant_id}/{self.document_id}",
             {"state": self.deli.checkpoint().to_dict()},
         )
+        self.scribe.checkpoint()
 
     def _on_sequenced(self, msg: SequencedDocumentMessage) -> None:
         self._log.append(

@@ -1,0 +1,146 @@
+"""Scribe: the durable protocol replica + summary commit validator.
+
+Ref: lambdas/src/scribe/lambda.ts:39,71,113 — consumes the sequenced
+stream, maintains a server-side ProtocolOpHandler replica (the same class
+the client runs — protocol-base is shared code), and on a client
+``summarize`` op validates the proposed summary's parentage against the
+last acked head (summaryWriter.ts:69-192 writeClientSummary) before
+acknowledging it into the total order. Acks/nacks travel BACK through the
+sequencer (send-to-deli), so every client sees them at the same stream
+position.
+
+Storage model: clients upload summary trees to the content-addressed
+store first (driver upload_summary → version record with parent link);
+scribe checks the chain and flips the version's ``acked`` flag — the
+analog of scribe creating the git commit + ref update.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+from ..protocol.quorum import ProtocolOpHandler
+from .core import InMemoryDb, QueuedMessage, summary_versions_collection
+from .deli import RawMessage
+
+SCRIBE_CHECKPOINT_COLLECTION = "scribe-checkpoints"
+
+
+class ScribeLambda:
+    def __init__(
+        self,
+        tenant_id: str,
+        document_id: str,
+        db: InMemoryDb,
+        send_to_deli: Callable[[RawMessage], None],
+        checkpoint: Optional[dict] = None,
+    ):
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self._db = db
+        self._send_to_deli = send_to_deli
+        self._versions_col = summary_versions_collection(tenant_id, document_id)
+        if checkpoint:
+            self.protocol = ProtocolOpHandler.load(checkpoint["protocol"])
+            self.last_summary_head: Optional[str] = checkpoint["head"]
+            self.last_offset: int = checkpoint["offset"]
+        else:
+            self.protocol = ProtocolOpHandler()
+            self.last_summary_head = None
+            self.last_offset = -1
+
+    def handler(self, message: QueuedMessage) -> None:
+        if message.offset <= self.last_offset:
+            return  # replay after restart
+        self.last_offset = message.offset
+        msg: SequencedDocumentMessage = message.value["message"]
+        self.protocol.process_message(msg)
+        if msg.type == MessageType.SUMMARIZE:
+            self._handle_summarize(msg)
+
+    def close(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ summaries
+
+    def _handle_summarize(self, msg: SequencedDocumentMessage) -> None:
+        contents = msg.contents or {}
+        handle = contents.get("handle")
+        parent = contents.get("parent")
+        version = self._db.find_one(self._versions_col, handle) if handle else None
+
+        if version is None:
+            self._nack(msg, f"unknown summary handle {handle!r}")
+            return
+        if parent != self.last_summary_head:
+            # parent must be the last acked head (summaryWriter.ts:85)
+            self._nack(
+                msg,
+                f"summary parent {parent!r} does not match head "
+                f"{self.last_summary_head!r}",
+            )
+            return
+
+        # commit: mark the version acked (the git ref update analog)
+        self._db.upsert(self._versions_col, handle, dict(version, acked=True))
+        self.last_summary_head = handle
+        self._send_to_deli(
+            RawMessage(
+                tenant_id=self.tenant_id,
+                document_id=self.document_id,
+                client_id=None,
+                operation=DocumentMessage(
+                    client_sequence_number=-1,
+                    reference_sequence_number=-1,
+                    type=MessageType.SUMMARY_ACK,
+                    contents={
+                        "handle": handle,
+                        "summarySequenceNumber": msg.sequence_number,
+                    },
+                ),
+            )
+        )
+
+    def _nack(self, msg: SequencedDocumentMessage, reason: str) -> None:
+        handle = (msg.contents or {}).get("handle")
+        version = self._db.find_one(self._versions_col, handle) if handle else None
+        if version is not None:
+            # a rejected upload must never become a boot source
+            self._db.upsert(self._versions_col, handle, dict(version, rejected=True))
+        self._send_to_deli(
+            RawMessage(
+                tenant_id=self.tenant_id,
+                document_id=self.document_id,
+                client_id=None,
+                operation=DocumentMessage(
+                    client_sequence_number=-1,
+                    reference_sequence_number=-1,
+                    type=MessageType.SUMMARY_NACK,
+                    contents={
+                        "handle": handle,
+                        "summarySequenceNumber": msg.sequence_number,
+                        "message": reason,
+                    },
+                ),
+            )
+        )
+
+    # ----------------------------------------------------------- checkpoint
+
+    def checkpoint(self) -> None:
+        self._db.upsert(
+            SCRIBE_CHECKPOINT_COLLECTION,
+            f"{self.tenant_id}/{self.document_id}",
+            {
+                "state": {
+                    "protocol": self.protocol.snapshot(),
+                    "head": self.last_summary_head,
+                    "offset": self.last_offset,
+                }
+            },
+        )

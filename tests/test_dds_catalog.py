@@ -308,15 +308,15 @@ def test_matrix_offline_edits_rebase(server, loader):
 
 
 def test_matrix_snapshot_boot(server, loader):
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
     c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
     m = c1.runtime.create_data_store("default").create_channel("m", "shared-matrix")
     m.insert_rows(0, 2)
     m.insert_cols(0, 2)
     m.set_cell(0, 1, 42)
-    summary = {"protocol": c1.protocol.snapshot(),
-               "runtime": c1.runtime.snapshot(),
-               "sequence_number": c1.delta_manager.last_processed_seq}
-    c1.storage.upload_summary(summary, parent=None)
+    sm.summarize_now()
     c3 = loader.resolve("t", "doc")
     m3 = c3.runtime.get_data_store("default").get_channel("m")
     assert m3.get_cell(0, 1) == 42
@@ -342,14 +342,14 @@ def test_matrix_removed_rows_purge_cell_storage(server, loader):
 # -------------------------------------------------------- summary block
 
 def test_summary_block_travels_via_snapshot_only(server, loader):
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
     c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
     sb = c1.runtime.create_data_store("default").create_channel(
         "sb", "shared-summary-block")
     sb.set("stats", {"count": 7})
-    summary = {"protocol": c1.protocol.snapshot(),
-               "runtime": c1.runtime.snapshot(),
-               "sequence_number": c1.delta_manager.last_processed_seq}
-    c1.storage.upload_summary(summary, parent=None)
+    sm.summarize_now()
     c2 = loader.resolve("t", "doc")
     sb2 = c2.runtime.get_data_store("default").get_channel("sb")
     assert sb2.get("stats") == {"count": 7}

@@ -198,16 +198,13 @@ def test_quorum_proposal_commits_via_msn(loader):
 
 
 def test_boot_from_snapshot_plus_tail(server, loader):
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
     c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
     s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
     s1.insert_text(0, "summarized")
-    # write a summary by hand (the summarizer subsystem automates this)
-    summary = {
-        "protocol": c1.protocol.snapshot(),
-        "runtime": c1.runtime.snapshot(),
-        "sequence_number": c1.delta_manager.last_processed_seq,
-    }
-    c1.storage.upload_summary(summary, parent=None)
+    sm.summarize_now()  # upload + SUMMARIZE op + scribe ack
     # more ops after the summary → the tail
     s1.insert_text(0, "tail ")
 

@@ -1,0 +1,159 @@
+"""Summary pipeline specs: client summarizer election + heuristics,
+scribe validation + ack/nack through the total order, boot from acked
+summaries, checkpoint/restart of scribe state.
+
+Ref: §3.4 call stack (summaryManager → generateSummary → scribe
+writeClientSummary → summaryAck) and summarizer unit/e2e coverage.
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.runtime.summarizer import SummaryManager
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def test_oldest_member_is_elected(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    sm1, sm2 = SummaryManager(c1), SummaryManager(c2)
+    assert sm1.elected_summarizer == c1.client_id
+    assert sm1.is_summarizer and not sm2.is_summarizer
+    c1.close()
+    # remaining oldest takes over
+    assert sm2.elected_summarizer == c2.client_id
+    assert sm2.is_summarizer
+
+
+def test_summary_acked_and_used_for_boot(loader):
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=3)
+    s = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s.insert_text(0, "abcdef")
+    s.remove_text(0, 2)
+    assert sm.summaries_acked >= 1  # heuristics fired and scribe acked
+    assert sm.last_acked_handle is not None
+
+    # a fresh client boots from the acked summary version + tail
+    c2 = loader.resolve("t", "doc")
+    assert c2._base_snapshot is not None  # actually booted from a summary
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == "cdef"
+    s2.insert_text(0, "x")
+    assert s.get_text() == "xcdef"
+
+
+def test_summary_chain_parents_link(loader):
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=2)
+    s = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    for i in range(8):
+        s.set(f"k{i}", i)
+    assert sm.summaries_acked >= 2
+    versions = c1.storage.get_versions(10)
+    assert len(versions) >= 2
+
+
+def test_stale_parent_summary_nacked(loader):
+    c1 = loader.resolve("t", "doc")
+    sm1 = SummaryManager(c1, max_ops=10_000)  # manual control
+    s = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    s.set("a", 1)
+    sm1.summarize_now()
+    assert sm1.summaries_acked == 1
+    # second summary lying about its parent → scribe nack
+    sm1.last_acked_handle = None  # fake a stale head
+    sm1.summarize_now()
+    assert sm1.summaries_nacked == 1
+    # the rejected version must not be served for boot
+    versions = c1.storage.get_versions(10)
+    assert all(v["id"] != sm1._pending_handle for v in versions)
+
+
+def test_summarizer_defers_with_pending_ops(server, loader):
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)  # manual control
+    s = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    server._auto_drain = False
+    s.set("a", 1)  # pending, unacked
+    with pytest.raises(RuntimeError):
+        sm.summarize_now()
+    server.drain()
+    sm.summarize_now()
+    server.drain()  # deliver the summarize op + scribe's ack
+    assert sm.summaries_acked == 1
+
+
+def test_scribe_restart_keeps_summary_head(server, loader):
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
+    s = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    s.set("a", 1)
+    sm.summarize_now()
+    assert sm.summaries_acked == 1
+    server.restart_orderer("t", "doc")
+    # the restarted scribe must remember the head: a proper child summary
+    # acks, a stale-parent one nacks
+    s.set("b", 2)
+    sm.summarize_now()
+    assert sm.summaries_acked == 2
+    assert sm.summaries_nacked == 0
+
+
+def test_non_summarizer_client_never_summarizes(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    sm1 = SummaryManager(c1, max_ops=2)
+    sm2 = SummaryManager(c2, max_ops=2)
+    kv2 = c2.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    for i in range(6):
+        kv2.set(f"k{i}", i)
+    assert sm2.summaries_acked == 0  # c2 is not elected
+    assert sm1.summaries_acked >= 1  # c1 is, and summarizes c2's ops
+
+
+def test_late_elected_summarizer_continues_chain(loader):
+    # a manager attached after boot must seed its head from storage, or
+    # its first proposal (parent=None) would nack-loop forever
+    c1 = loader.resolve("t", "doc")
+    sm1 = SummaryManager(c1, max_ops=10_000)
+    kv = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    kv.set("a", 1)
+    sm1.summarize_now()
+    assert sm1.summaries_acked == 1
+
+    c2 = loader.resolve("t", "doc")
+    sm2 = SummaryManager(c2, max_ops=10_000)
+    assert sm2.last_acked_handle == sm1.last_acked_handle
+    c1.close()  # c2 becomes the elected summarizer
+    kv2 = c2.runtime.get_data_store("default").get_channel("kv")
+    kv2.set("b", 2)
+    sm2.summarize_now()
+    assert sm2.summaries_acked == 1 and sm2.summaries_nacked == 0
+
+
+def test_boot_from_summary_sequence_numbers_align(loader):
+    # protocol gap check: booting client must resume at exactly the
+    # summary's sequence number with no gap or dup
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
+    st = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    st.insert_text(0, "hello")
+    sm.summarize_now()
+    c2 = loader.resolve("t", "doc")
+    st2 = c2.runtime.get_data_store("default").get_channel("text")
+    st2.insert_text(5, "!")
+    assert st.get_text() == st2.get_text() == "hello!"
+    assert c2.protocol.sequence_number == c2.delta_manager.last_processed_seq
