@@ -35,7 +35,6 @@ class SummaryManager:
         self.container = container
         self.max_ops = max_ops
         self.last_acked_handle: Optional[str] = None
-        self.last_acked_seq = 0
         self._pending_handle: Optional[str] = None
         self._ops_since_ack = 0
         self.summaries_acked = 0
@@ -46,9 +45,6 @@ class SummaryManager:
         versions = container.storage.get_versions(1)
         if versions:
             self.last_acked_handle = versions[0]["id"]
-            tree = container.storage.get_snapshot_tree(versions[0])
-            if tree:
-                self.last_acked_seq = tree.get("sequence_number", 0)
         container.add_message_observer(self._observe)
 
     # ------------------------------------------------------------ election
@@ -75,8 +71,6 @@ class SummaryManager:
         if msg.type == MessageType.SUMMARY_ACK:
             handle = (msg.contents or {}).get("handle")
             self.last_acked_handle = handle
-            self.last_acked_seq = (msg.contents or {}).get(
-                "summarySequenceNumber", msg.sequence_number)
             self._ops_since_ack = 0
             if handle == self._pending_handle:
                 self._pending_handle = None

@@ -72,6 +72,7 @@ class ScribeLambda:
         contents = msg.contents or {}
         handle = contents.get("handle")
         parent = contents.get("parent")
+        head = contents.get("head")
         version = self._db.find_one(self._versions_col, handle) if handle else None
 
         if version is None:
@@ -84,6 +85,12 @@ class ScribeLambda:
                 f"summary parent {parent!r} does not match head "
                 f"{self.last_summary_head!r}",
             )
+            return
+        if not isinstance(head, int) or head > msg.sequence_number:
+            # a summary claiming to cover sequence numbers beyond the
+            # stream would poison every future boot (clients would resume
+            # at the bogus seq and drop real ops as duplicates)
+            self._nack(msg, f"summary head {head!r} is ahead of the stream")
             return
 
         # commit: mark the version acked (the git ref update analog)
@@ -107,11 +114,9 @@ class ScribeLambda:
         )
 
     def _nack(self, msg: SequencedDocumentMessage, reason: str) -> None:
+        # boot visibility needs no marking here: only versions scribe acks
+        # (acked=True) are served by storage get_versions
         handle = (msg.contents or {}).get("handle")
-        version = self._db.find_one(self._versions_col, handle) if handle else None
-        if version is not None:
-            # a rejected upload must never become a boot source
-            self._db.upsert(self._versions_col, handle, dict(version, rejected=True))
         self._send_to_deli(
             RawMessage(
                 tenant_id=self.tenant_id,

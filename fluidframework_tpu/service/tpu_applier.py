@@ -1,0 +1,253 @@
+"""TpuDocumentApplier: batched server-side merge-tree replica farm.
+
+THE TPU-differentiating service component. The reference's server keeps no
+document state (architecture.md: server sequences, clients merge) and pays
+for it when it needs content — scribe replays whole op logs in JS to build
+service summaries (scribe writeServiceSummary, SURVEY §3.4). Here the
+service maintains thousands of documents as ONE device-resident
+structure-of-arrays batch (ops/doc_state.DocState with a leading doc dim)
+and applies every sequenced merge-tree op as a vmapped tensor program
+(ops/apply.py), optionally sharded over a ('docs','seg') mesh
+(parallel/sharded_apply.py). That turns BASELINE config 5 (10k-doc scribe
+replay) into a handful of XLA dispatches.
+
+Semantics guardrails:
+- Ops ingest ONLY from the sequenced stream, so the server-side invariants
+  hold (every stamp below the incoming seq; tie-break = earliest
+  boundary — see ops/apply.py docstring).
+- Anything the kernel does not model (annotate ops, slot-capacity or
+  remove-overlap overflow) flips the doc to HOST mode: the scalar oracle
+  (mergetree/) replays the doc's authoritative op log from scriptorium.
+  This is the overflow-to-host escape hatch of SURVEY §7(e).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..mergetree.client import MergeTreeClient
+from ..mergetree.ops import AnnotateOp, GroupOp, InsertOp, RemoveOp, op_from_wire
+from ..ops.apply import (
+    OP_FIELDS,
+    OP_INSERT,
+    OP_REMOVE,
+    apply_ops_batch,
+    compact_batch,
+    make_op,
+)
+from ..ops.doc_state import DocState, TextArena
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+from ..parallel.placement import DocPlacement
+
+MARKER_GLYPH = "￼"
+
+
+def _intern_client(client_id: Optional[str]) -> int:
+    """Stable 24-bit client id for stamp comparisons. Deterministic across
+    processes (unlike hash()); a collision would merge two clients'
+    own-op visibility, astronomically unlikely within one doc's lifetime
+    of connected clients."""
+    if client_id is None:
+        return (1 << 24) - 1
+    return int.from_bytes(
+        hashlib.sha1(client_id.encode()).digest()[:3], "little")
+
+
+def channel_stream(server, tenant_id: str, document_id: str,
+                   ds_id: str, channel_id: str):
+    """Extract one channel's merge-tree messages from the document's
+    sequenced op log (scriptorium) — the applier's replay source and the
+    scribe-replay entry point (BASELINE config 5)."""
+    for m in server.get_deltas(tenant_id, document_id, 0, 10**9):
+        if m.type != MessageType.OPERATION:
+            continue
+        env = m.contents
+        if not isinstance(env, dict) or env.get("kind") != "chanop":
+            continue
+        if env["address"] != ds_id:
+            continue
+        inner = env["contents"]
+        if inner.get("address") != channel_id or "attach" in inner:
+            continue
+        yield replace(m, contents=inner["contents"])
+
+
+class TpuDocumentApplier:
+    """Maintains [D, S] device doc states fed by sequenced op streams."""
+
+    def __init__(
+        self,
+        max_docs: int = 256,
+        max_slots: int = 256,
+        ops_per_dispatch: int = 16,
+        mesh=None,
+    ):
+        self.max_docs = max_docs
+        self.max_slots = max_slots
+        self.K = ops_per_dispatch
+        self.placement = DocPlacement(n_shards=1, slots_per_shard=max_docs)
+        self.state: DocState = jax.vmap(lambda _: DocState.empty(max_slots))(
+            jnp.arange(max_docs)
+        )
+        self.arenas: list[TextArena] = [TextArena() for _ in range(max_docs)]
+        self._staged: dict[int, list[np.ndarray]] = {}
+        self._host_docs: dict[int, MergeTreeClient] = {}  # escalated docs
+        self._doc_keys: dict[int, tuple[str, str]] = {}
+        self._mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharded_apply import make_sharded_step, shard_state
+
+            self.state = shard_state(self.state, mesh)
+            self._step = make_sharded_step(mesh)
+        else:
+            self._step = jax.jit(self._local_step, donate_argnums=(0,))
+        self.dispatches = 0
+        self.ops_applied = 0
+        self.host_escalations = 0
+
+    @staticmethod
+    def _local_step(state: DocState, ops: jax.Array, min_seq: jax.Array):
+        state = apply_ops_batch(state, ops)
+        state = compact_batch(state, jnp.broadcast_to(min_seq, state.count.shape))
+        return state, {}
+
+    # ------------------------------------------------------------- ingest
+
+    def slot_of(self, tenant_id: str, document_id: str) -> int:
+        shard, slot = self.placement.place(tenant_id, document_id)
+        self._doc_keys.setdefault(slot, (tenant_id, document_id))
+        return slot
+
+    def ingest(
+        self,
+        tenant_id: str,
+        document_id: str,
+        msg: SequencedDocumentMessage,
+        wire_op: dict,
+    ) -> None:
+        """Stage one sequenced merge-tree wire op for batched apply."""
+        slot = self.slot_of(tenant_id, document_id)
+        if slot in self._host_docs:
+            self._apply_host(slot, msg, wire_op)
+            return
+        ops = self._vectorize(slot, msg, op_from_wire(wire_op))
+        if ops is None:
+            self._escalate(slot, msg, wire_op)
+        else:
+            self._staged.setdefault(slot, []).extend(ops)
+
+    def _vectorize(self, slot, msg, op) -> Optional[list[np.ndarray]]:
+        if isinstance(op, GroupOp):
+            out = []
+            for sub in op.ops:
+                vecs = self._vectorize(slot, msg, sub)
+                if vecs is None:
+                    return None
+                out.extend(vecs)
+            return out
+        common = dict(
+            seq=msg.sequence_number,
+            ref_seq=msg.reference_sequence_number,
+            client=_intern_client(msg.client_id),
+        )
+        if isinstance(op, InsertOp):
+            text = MARKER_GLYPH if op.marker is not None else (op.text or "")
+            start = self.arenas[slot].append(text)
+            return [make_op(OP_INSERT, pos=op.pos, text_len=len(text),
+                            text_start=start, **common)]
+        if isinstance(op, RemoveOp):
+            return [make_op(OP_REMOVE, pos=op.start, end=op.end, **common)]
+        if isinstance(op, AnnotateOp):
+            return None  # property ops are host-mode only
+        return None
+
+    # -------------------------------------------------------------- flush
+
+    def flush(self) -> int:
+        """Dispatch all staged ops to the device in [D, K] waves."""
+        total = 0
+        while self._staged:
+            batch = np.zeros((self.max_docs, self.K, OP_FIELDS), np.int32)
+            drained = []
+            for slot, ops in self._staged.items():
+                take = min(len(ops), self.K)
+                batch[slot, :take] = ops[:take]
+                total += take
+                if take == len(ops):
+                    drained.append(slot)
+                else:
+                    self._staged[slot] = ops[take:]
+            for slot in drained:
+                del self._staged[slot]
+            ops_dev = jnp.asarray(batch)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                ops_dev = jax.device_put(
+                    ops_dev, NamedSharding(self._mesh, P("docs")))
+            self.state, _ = self._step(
+                self.state, ops_dev, jnp.asarray(0, jnp.int32))
+            self.dispatches += 1
+        self.ops_applied += total
+        self._check_overflow()
+        return total
+
+    def _check_overflow(self) -> None:
+        flags = np.asarray(self.state.overflow)
+        for slot in np.nonzero(flags)[0]:
+            if int(slot) not in self._host_docs:
+                self._escalate(int(slot), None, None)
+
+    # ------------------------------------------------------------- queries
+
+    def get_text(self, tenant_id: str, document_id: str) -> str:
+        slot = self.slot_of(tenant_id, document_id)
+        if self._staged.get(slot):
+            self.flush()
+        if slot in self._host_docs:
+            return self._host_docs[slot].get_text()
+        single = jax.tree.map(lambda a: np.asarray(a)[slot], self.state)
+        out, arena = [], self.arenas[slot]
+        for i in range(int(single.count)):
+            if single.rem_seq[i] != -1:
+                continue
+            text = arena.slice(int(single.text_start[i]), int(single.length[i]))
+            if text != MARKER_GLYPH:
+                out.append(text)
+        return "".join(out)
+
+    # ---------------------------------------------------- host escalation
+
+    def _escalate(self, slot: int, msg, wire_op) -> None:
+        """Rebuild the doc on the scalar oracle from its authoritative op
+        log and continue host-side (SURVEY §7(e) escape hatch)."""
+        self.host_escalations += 1
+        tenant_id, document_id = self._doc_keys[slot]
+        replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
+        self._host_docs[slot] = replica
+        self._staged.pop(slot, None)
+        if self._replay_log is not None:
+            for m in self._replay_log(tenant_id, document_id):
+                if m.type == MessageType.OPERATION:
+                    replica.apply_msg(m, local=False)
+        if msg is not None:
+            self._apply_host(slot, msg, wire_op)
+
+    def _apply_host(self, slot: int, msg, wire_op) -> None:
+        replica = self._host_docs[slot]
+        if msg.sequence_number <= replica.tree.current_seq:
+            return  # already covered by the escalation replay
+        replica.apply_msg(replace(msg, contents=wire_op), local=False)
+
+    # the host replay source: fn(tenant, doc) -> [SequencedDocumentMessage]
+    # of CHANNEL-LEVEL merge-tree messages; wired by the service host
+    _replay_log = None
+
+    def set_replay_source(self, fn) -> None:
+        self._replay_log = fn

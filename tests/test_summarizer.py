@@ -75,11 +75,12 @@ def test_stale_parent_summary_nacked(loader):
     assert sm1.summaries_acked == 1
     # second summary lying about its parent → scribe nack
     sm1.last_acked_handle = None  # fake a stale head
-    sm1.summarize_now()
+    nacked_handle = sm1.summarize_now()
     assert sm1.summaries_nacked == 1
     # the rejected version must not be served for boot
     versions = c1.storage.get_versions(10)
-    assert all(v["id"] != sm1._pending_handle for v in versions)
+    assert nacked_handle is not None
+    assert all(v["id"] != nacked_handle for v in versions)
 
 
 def test_summarizer_defers_with_pending_ops(server, loader):
@@ -142,6 +143,24 @@ def test_late_elected_summarizer_continues_chain(loader):
     kv2.set("b", 2)
     sm2.summarize_now()
     assert sm2.summaries_acked == 1 and sm2.summaries_nacked == 0
+
+
+def test_future_head_summary_nacked(loader):
+    # a summary claiming to cover seqs beyond the stream must be rejected
+    # or booting clients would resume past real ops and drop them
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10_000)
+    kv = c1.runtime.create_data_store("default").create_channel("kv", "shared-map")
+    kv.set("a", 1)
+    summary = {"protocol": c1.protocol.snapshot(),
+               "runtime": c1.runtime.snapshot(),
+               "sequence_number": 999}  # lie
+    handle = c1.storage.upload_summary(summary, parent=None)
+    c1.delta_manager.submit(
+        MessageType.SUMMARIZE, {"handle": handle, "parent": None, "head": 999})
+    assert c1.storage.get_versions(10) == []  # nothing committed
+    sm.summarize_now()  # an honest summary still goes through
+    assert sm.summaries_acked == 1
 
 
 def test_boot_from_summary_sequence_numbers_align(loader):

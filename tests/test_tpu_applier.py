@@ -1,0 +1,125 @@
+"""TpuDocumentApplier: the batched device replica must match the scalar
+client replicas for every doc — the kernel-vs-full-stack convergence
+check (the TPU analog of PartialSequenceLengths verification + the
+scribe-replay BASELINE config 5).
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier, channel_stream
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def feed_applier(applier, server, tenant, doc):
+    for msg in channel_stream(server, tenant, doc, "default", "text"):
+        applier.ingest(tenant, doc, msg, msg.contents)
+    applier.flush()
+
+
+def test_applier_matches_client_replicas(server, loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s1.insert_text(0, "hello world")
+    s2.insert_text(5, ", tpu")
+    s1.remove_text(0, 5)
+    s2.insert_text(s2.get_text().__len__(), "!")
+    assert s1.get_text() == s2.get_text()
+
+    applier = TpuDocumentApplier(max_docs=8, max_slots=64, ops_per_dispatch=4)
+    feed_applier(applier, server, "t", "doc")
+    assert applier.get_text("t", "doc") == s1.get_text()
+    assert applier.host_escalations == 0
+
+
+def test_applier_many_docs_fuzz(server, loader):
+    rng = np.random.default_rng(11)
+    docs = [f"doc{i}" for i in range(6)]
+    strings = {}
+    for d in docs:
+        c = loader.resolve("t", d)
+        strings[d] = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+    for _ in range(120):
+        d = docs[rng.integers(0, len(docs))]
+        s = strings[d]
+        n = len(s.get_text())
+        if n > 4 and rng.random() < 0.35:
+            a = int(rng.integers(0, n - 1))
+            b = int(rng.integers(a + 1, n + 1))
+            s.remove_text(a, b)
+        else:
+            pos = int(rng.integers(0, n + 1))
+            s.insert_text(pos, f"[{rng.integers(0, 100)}]")
+
+    applier = TpuDocumentApplier(max_docs=16, max_slots=512, ops_per_dispatch=8)
+    for d in docs:
+        feed_applier(applier, server, "t", d)
+    for d in docs:
+        assert applier.get_text("t", d) == strings[d].get_text(), d
+    assert applier.host_escalations == 0
+    assert applier.dispatches > 0
+
+
+def test_applier_escalates_annotate_to_host(server, loader):
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s1.insert_text(0, "styled text")
+    s1.annotate_range(0, 6, {"bold": True})
+    s1.insert_text(0, "x")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=32, ops_per_dispatch=4)
+    applier.set_replay_source(
+        lambda t, d: list(channel_stream(server, t, d, "default", "text")))
+    feed_applier(applier, server, "t", "doc")
+    assert applier.host_escalations == 1
+    assert applier.get_text("t", "doc") == s1.get_text()
+
+
+def test_applier_escalates_capacity_overflow(server, loader):
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    for i in range(30):  # far beyond 8 slots after splits
+        s1.insert_text(len(s1.get_text()) // 2, f"seg{i}")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=8, ops_per_dispatch=4)
+    applier.set_replay_source(
+        lambda t, d: list(channel_stream(server, t, d, "default", "text")))
+    feed_applier(applier, server, "t", "doc")
+    assert applier.host_escalations == 1
+    assert applier.get_text("t", "doc") == s1.get_text()
+
+
+def test_applier_on_virtual_mesh(server, loader):
+    from fluidframework_tpu.parallel.mesh import make_mesh
+
+    docs = [f"doc{i}" for i in range(4)]
+    strings = {}
+    for d in docs:
+        c = loader.resolve("t", d)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, f"content of {d}")
+        strings[d] = s
+
+    mesh = make_mesh(8, seg_shards=1)
+    applier = TpuDocumentApplier(max_docs=8, max_slots=64,
+                                 ops_per_dispatch=4, mesh=mesh)
+    for d in docs:
+        feed_applier(applier, server, "t", d)
+    for d in docs:
+        assert applier.get_text("t", d) == strings[d].get_text()
