@@ -1,0 +1,25 @@
+"""Native (C++) runtime components and their ctypes bindings.
+
+Ref: SURVEY §2.9 — the reference's only native code is librdkafka (the
+ordered op log) and libgit2 (content-addressed snapshot storage). This
+package provides the TPU build's equivalents:
+
+- ``oplog``      durable append-only partitioned log (native/oplog.cpp)
+- ``chunkstore`` sha256-addressed blob store (native/chunkstore.cpp)
+
+Binaries build lazily on first use with g++ (cached under
+native/build/); environments without a toolchain raise
+``NativeUnavailable`` and callers fall back to the in-memory pure-Python
+equivalents (LocalLog, InMemoryDb-backed storage).
+"""
+
+from .build import NativeUnavailable, native_available
+from .oplog import NativeOpLog
+from .chunkstore import NativeChunkStore
+
+__all__ = [
+    "NativeUnavailable",
+    "native_available",
+    "NativeOpLog",
+    "NativeChunkStore",
+]

@@ -1,0 +1,53 @@
+"""Lazy g++ build of the native components, cached by source hash."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import pathlib
+import subprocess
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_SRC_DIR = _REPO_ROOT / "native"
+_BUILD_DIR = _SRC_DIR / "build"
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _source(name: str) -> pathlib.Path:
+    return _SRC_DIR / f"{name}.cpp"
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if stale) and dlopen native/<name>.cpp → <name>-<hash>.so."""
+    src = _source(name)
+    if not src.exists():
+        raise NativeUnavailable(f"missing source {src}")
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    so_path = _BUILD_DIR / f"{name}-{digest}.so"
+    if not so_path.exists():
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        tmp = so_path.with_suffix(".so.tmp")
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            str(src), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except FileNotFoundError as e:
+            raise NativeUnavailable("g++ not found") from e
+        except subprocess.CalledProcessError as e:
+            raise NativeUnavailable(
+                f"compile failed:\n{e.stderr.decode(errors='replace')}") from e
+        tmp.rename(so_path)
+    return ctypes.CDLL(str(so_path))
+
+
+def native_available() -> bool:
+    try:
+        load_library("oplog")
+        return True
+    except NativeUnavailable:
+        return False

@@ -1,0 +1,71 @@
+"""ctypes binding for the durable op log (native/oplog.cpp)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .build import load_library
+
+
+class NativeOpLog:
+    """Durable append-only partitioned log of byte records."""
+
+    def __init__(self, directory: str):
+        self._lib = load_library("oplog")
+        self._lib.oplog_open.restype = ctypes.c_void_p
+        self._lib.oplog_open.argtypes = [ctypes.c_char_p]
+        self._lib.oplog_close.argtypes = [ctypes.c_void_p]
+        self._lib.oplog_append.restype = ctypes.c_int64
+        self._lib.oplog_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+        self._lib.oplog_length.restype = ctypes.c_int64
+        self._lib.oplog_length.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        self._lib.oplog_read.restype = ctypes.c_int64
+        self._lib.oplog_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64]
+        self._lib.oplog_sync.restype = ctypes.c_int
+        self._lib.oplog_sync.argtypes = [ctypes.c_void_p]
+        self._handle = self._lib.oplog_open(directory.encode())
+        if not self._handle:
+            raise OSError(f"cannot open op log at {directory}")
+
+    def append(self, topic: str, record: bytes) -> int:
+        off = self._lib.oplog_append(
+            self._handle, topic.encode(), record, len(record))
+        if off < 0:
+            raise OSError(f"append to {topic!r} failed")
+        return off
+
+    def length(self, topic: str) -> int:
+        n = self._lib.oplog_length(self._handle, topic.encode())
+        if n < 0:
+            raise OSError(f"bad topic {topic!r}")
+        return n
+
+    def read(self, topic: str, offset: int) -> bytes:
+        size = 4096
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.oplog_read(
+                self._handle, topic.encode(), offset, buf, size)
+            if n < 0:
+                raise IndexError(f"no record {offset} in {topic!r}")
+            if n <= size:
+                return buf.raw[:n]
+            size = n  # buffer too small: retry at the reported size
+
+    def sync(self) -> None:
+        if self._lib.oplog_sync(self._handle) != 0:
+            raise OSError("sync failed")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.oplog_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

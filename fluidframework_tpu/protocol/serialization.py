@@ -1,0 +1,78 @@
+"""Wire serialization for protocol messages (JSON).
+
+One canonical encoding shared by the durable native log, the network
+front end, and the replay tooling — the analog of the reference's JSON
+socket/Kafka payloads (protocol-definitions types are the schema).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+from typing import Any
+
+from .messages import (
+    DocumentMessage,
+    MessageType,
+    Nack,
+    NackErrorType,
+    SequencedDocumentMessage,
+    Signal,
+    TraceHop,
+)
+
+_KINDS = {
+    "doc": DocumentMessage,
+    "seq": SequencedDocumentMessage,
+    "nack": Nack,
+    "signal": Signal,
+}
+# custom codecs for types outside protocol.messages (e.g. service
+# RawMessage): kind → (cls, to_dict, from_dict)
+_CUSTOM: dict[str, tuple] = {}
+
+
+def register_message_type(kind: str, cls: type, to_dict, from_dict) -> None:
+    _CUSTOM[kind] = (cls, to_dict, from_dict)
+
+
+def message_to_dict(msg: Any) -> dict:
+    for kind, cls in _KINDS.items():
+        if isinstance(msg, cls):
+            d = asdict(msg)
+            d["_kind"] = kind
+            return d
+    for kind, (cls, to_dict, _) in _CUSTOM.items():
+        if isinstance(msg, cls):
+            return dict(to_dict(msg), _kind=kind)
+    raise TypeError(f"unknown message type {type(msg)!r}")
+
+
+def message_from_dict(d: dict) -> Any:
+    d = dict(d)
+    kind = d.pop("_kind")
+    if kind in _CUSTOM:
+        return _CUSTOM[kind][2](d)
+    cls = _KINDS[kind]
+    if "traces" in d:
+        d["traces"] = [TraceHop(**t) for t in d["traces"]]
+    if "type" in d:
+        d["type"] = (
+            NackErrorType(d["type"]) if kind == "nack"
+            else d["type"] if kind == "signal"
+            else MessageType(d["type"])
+        )
+    if kind == "nack" and d.get("operation") is not None:
+        op = dict(d["operation"])
+        op["type"] = MessageType(op["type"])
+        op["traces"] = [TraceHop(**t) for t in op.get("traces", [])]
+        d["operation"] = DocumentMessage(**op)
+    return cls(**d)
+
+
+def encode_message(msg: Any) -> bytes:
+    return json.dumps(message_to_dict(msg), separators=(",", ":")).encode()
+
+
+def decode_message(data: bytes) -> Any:
+    return message_from_dict(json.loads(data.decode()))

@@ -46,6 +46,39 @@ class RawMessage:
     timestamp: float = 0.0
 
 
+def _raw_to_dict(raw: RawMessage) -> dict:
+    from ..protocol.serialization import message_to_dict
+
+    return {
+        "tenant_id": raw.tenant_id,
+        "document_id": raw.document_id,
+        "client_id": raw.client_id,
+        "operation": message_to_dict(raw.operation),
+        "timestamp": raw.timestamp,
+    }
+
+
+def _raw_from_dict(d: dict) -> RawMessage:
+    from ..protocol.serialization import message_from_dict
+
+    return RawMessage(
+        tenant_id=d["tenant_id"],
+        document_id=d["document_id"],
+        client_id=d["client_id"],
+        operation=message_from_dict(d["operation"]),
+        timestamp=d["timestamp"],
+    )
+
+
+def _register_raw_codec() -> None:
+    from ..protocol.serialization import register_message_type
+
+    register_message_type("raw", RawMessage, _raw_to_dict, _raw_from_dict)
+
+
+_register_raw_codec()
+
+
 @dataclass
 class ClientState:
     """Per-client sequencing state (ref: deli/clientSeqManager.ts)."""

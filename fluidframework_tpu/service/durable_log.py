@@ -1,0 +1,88 @@
+"""DurableLog: the ordered-log interface over the native C++ op log.
+
+Drop-in for LocalLog in LocalOrderer/LocalServer (same OrderedLogBase
+machinery), but every record is persisted through native/oplog.cpp, so a
+process restart resumes the pipeline from disk — the single-node
+durability story the reference gets from Kafka+Mongo (SURVEY §2.9
+consolidation note).
+
+Values must be protocol messages or JSON-serializable structures; they
+are encoded via protocol/serialization with explicit tagging, and user
+dicts that happen to collide with the tag keys are escaped, so framing is
+unambiguous. Subscriber positions are in-memory (the lambdas own their
+checkpoints, as in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import json
+
+from ..native.oplog import NativeOpLog
+from ..protocol.serialization import message_from_dict, message_to_dict
+from .local_log import OrderedLogBase
+
+_TAG_MSG = "_msg"  # a wrapped protocol message
+_TAG_ESC = "_esc"  # an escaped user dict that contained a tag key
+
+
+def _wrap(value: Any) -> Any:
+    """Recursively tag protocol messages / escape colliding user dicts."""
+    if isinstance(value, dict):
+        out = {k: _wrap(v) for k, v in value.items()}
+        if _TAG_MSG in out or _TAG_ESC in out:
+            return {_TAG_ESC: out}
+        return out
+    if isinstance(value, (list, tuple)):
+        return [_wrap(v) for v in value]
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return {_TAG_MSG: message_to_dict(value)}
+
+
+def _unwrap(value: Any) -> Any:
+    if isinstance(value, dict):
+        if _TAG_MSG in value and len(value) == 1:
+            return message_from_dict(value[_TAG_MSG])
+        if _TAG_ESC in value and len(value) == 1:
+            return {k: _unwrap(v) for k, v in value[_TAG_ESC].items()}
+        return {k: _unwrap(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_unwrap(v) for v in value]
+    return value
+
+
+def _encode_value(value: Any) -> bytes:
+    return json.dumps(_wrap(value), separators=(",", ":")).encode()
+
+
+def _decode_value(data: bytes) -> Any:
+    return _unwrap(json.loads(data.decode()))
+
+
+def _sanitize(topic: str) -> str:
+    return topic.replace("/", ".")
+
+
+class DurableLog(OrderedLogBase):
+    """Persistent ordered topics with subscriber fan-out."""
+
+    def __init__(self, directory: str):
+        super().__init__()
+        self._log = NativeOpLog(directory)
+
+    def _store(self, topic: str, value: Any) -> int:
+        return self._log.append(_sanitize(topic), _encode_value(value))
+
+    def _load(self, topic: str, offset: int) -> Any:
+        return _decode_value(self._log.read(_sanitize(topic), offset))
+
+    def _stored_length(self, topic: str) -> int:
+        return self._log.length(_sanitize(topic))
+
+    def sync(self) -> None:
+        self._log.sync()
+
+    def close(self) -> None:
+        self._log.close()

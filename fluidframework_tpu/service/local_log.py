@@ -1,4 +1,4 @@
-"""In-memory ordered log: the LocalKafka analog.
+"""Ordered log: topics + subscriber fan-out, with pluggable storage.
 
 Ref: memory-orderer/src/localKafka.ts — an append-only per-partition
 message list with monotonically increasing offsets, drained synchronously
@@ -6,9 +6,11 @@ into subscribed lambdas. Deterministic drain order (topic registration
 order, then offset order) is what makes multi-client interleaving tests
 reproducible (the OpProcessingController property, SURVEY §4).
 
-The production analog is the C++ sharded op log (SURVEY §2.9); both sides
-present the same (append → offset, subscribe → in-order handler calls)
-contract, so every lambda runs unchanged over either.
+``OrderedLogBase`` owns the subtle parts once — subscriber positions,
+fixed-point drain, single-step delivery — over three storage primitives:
+``_store`` / ``_load`` / ``_stored_length``. ``LocalLog`` keeps records
+in memory; ``service.durable_log.DurableLog`` persists them through the
+native C++ op log (the librdkafka-role component, SURVEY §2.9).
 """
 
 from __future__ import annotations
@@ -17,41 +19,52 @@ from typing import Any, Callable
 
 from .core import QueuedMessage
 
+Handler = Callable[[QueuedMessage], None]
 
-class LocalLog:
-    """Named topics of ordered partitions with subscriber fan-out."""
 
+class OrderedLogBase:
     def __init__(self):
-        self._topics: dict[str, list[QueuedMessage]] = {}
-        # subscriber positions: (topic, id) -> next offset to deliver
-        self._subs: dict[str, list[tuple[Callable[[QueuedMessage], None], list[int]]]] = {}
+        self._subs: dict[str, list[tuple[Handler, list[int]]]] = {}
         self._order: list[str] = []
 
+    # ------------------------------------------------- storage primitives
+
+    def _store(self, topic: str, value: Any) -> int:
+        """Append; returns the record's offset."""
+        raise NotImplementedError
+
+    def _load(self, topic: str, offset: int) -> Any:
+        raise NotImplementedError
+
+    def _stored_length(self, topic: str) -> int:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- topic api
+
     def create_topic(self, topic: str) -> None:
-        if topic not in self._topics:
-            self._topics[topic] = []
+        if topic not in self._subs:
             self._subs[topic] = []
             self._order.append(topic)
 
     def append(self, topic: str, value: Any, partition: int = 0) -> int:
         self.create_topic(topic)
-        log = self._topics[topic]
-        offset = len(log)
-        log.append(QueuedMessage(offset=offset, topic=topic, partition=partition, value=value))
-        return offset
+        return self._store(topic, value)
 
-    def subscribe(
-        self,
-        topic: str,
-        handler: Callable[[QueuedMessage], None],
-        from_offset: int = 0,
-    ) -> None:
+    def subscribe(self, topic: str, handler: Handler, from_offset: int = 0) -> None:
         self.create_topic(topic)
         self._subs[topic].append((handler, [from_offset]))
 
-    def unsubscribe(self, topic: str, handler: Callable[[QueuedMessage], None]) -> None:
+    def unsubscribe(self, topic: str, handler: Handler) -> None:
         subs = self._subs.get(topic, [])
         self._subs[topic] = [(h, p) for h, p in subs if h is not handler]
+
+    def length(self, topic: str) -> int:
+        return self._stored_length(topic)
+
+    def read(self, topic: str, offset: int) -> Any:
+        return self._load(topic, offset)
+
+    # ------------------------------------------------------------ delivery
 
     def drain(self) -> int:
         """Deliver pending messages to all subscribers until quiescent.
@@ -63,11 +76,12 @@ class LocalLog:
         progressed = True
         while progressed:
             progressed = False
-            for topic in self._order:
-                log = self._topics[topic]
+            for topic in list(self._order):
                 for handler, pos in self._subs[topic]:
-                    while pos[0] < len(log):
-                        msg = log[pos[0]]
+                    while pos[0] < self._stored_length(topic):
+                        msg = QueuedMessage(
+                            offset=pos[0], topic=topic, partition=0,
+                            value=self._load(topic, pos[0]))
                         pos[0] += 1
                         handler(msg)
                         delivered += 1
@@ -78,15 +92,32 @@ class LocalLog:
         """Deliver exactly ONE pending message on ``topic`` to each lagging
         subscriber — the deterministic single-step used by interleaving
         tests. Returns False when the topic is fully drained."""
-        log = self._topics.get(topic, [])
+        n = self._stored_length(topic)
         any_delivered = False
         for handler, pos in self._subs.get(topic, []):
-            if pos[0] < len(log):
-                msg = log[pos[0]]
+            if pos[0] < n:
+                msg = QueuedMessage(offset=pos[0], topic=topic, partition=0,
+                                    value=self._load(topic, pos[0]))
                 pos[0] += 1
                 handler(msg)
                 any_delivered = True
         return any_delivered
 
-    def length(self, topic: str) -> int:
+
+class LocalLog(OrderedLogBase):
+    """In-memory ordered log (the LocalKafka analog)."""
+
+    def __init__(self):
+        super().__init__()
+        self._topics: dict[str, list[Any]] = {}
+
+    def _store(self, topic: str, value: Any) -> int:
+        records = self._topics.setdefault(topic, [])
+        records.append(value)
+        return len(records) - 1
+
+    def _load(self, topic: str, offset: int) -> Any:
+        return self._topics[topic][offset]
+
+    def _stored_length(self, topic: str) -> int:
         return len(self._topics.get(topic, []))

@@ -23,6 +23,25 @@ from .scriptorium import ScriptoriumLambda
 CHECKPOINT_COLLECTION = "deli-checkpoints"
 
 
+def _checkpoint_topic(tenant_id: str, document_id: str) -> str:
+    # per-doc topic: the newest checkpoint is simply the last record, and
+    # old records compact trivially
+    return f"checkpoints/{tenant_id}/{document_id}"
+
+
+def _latest_log_checkpoint(log, tenant_id: str, document_id: str):
+    """Newest checkpoint record for a doc from its checkpoint topic — the
+    recovery source when the db died with the process (DurableLog)."""
+    topic = _checkpoint_topic(tenant_id, document_id)
+    try:
+        n = log.length(topic)
+        if n <= 0:
+            return None
+        return log.read(topic, n - 1)
+    except Exception:
+        return None
+
+
 class LocalOrderer:
     def __init__(
         self,
@@ -42,10 +61,19 @@ class LocalOrderer:
         self.raw_topic = f"rawops/{tenant_id}/{document_id}"
         self.deltas_topic = f"deltas/{tenant_id}/{document_id}"
 
-        # restore deli from its checkpoint if present (restart path,
-        # ref: deli/lambdaFactory.ts:54)
+        # restore deli from its checkpoint if present (restart path, ref:
+        # deli/lambdaFactory.ts:54). Two sources: the db (in-proc restart)
+        # and the log's checkpoint topic (process restart with a durable
+        # log, where the db died too) — prefer whichever is newer.
         cp_doc = db.find_one(CHECKPOINT_COLLECTION, f"{tenant_id}/{document_id}")
         checkpoint = DeliCheckpoint.from_dict(cp_doc["state"]) if cp_doc else None
+        log_cp = _latest_log_checkpoint(log, tenant_id, document_id)
+        scribe_log_cp = None
+        if log_cp is not None:
+            log_deli = DeliCheckpoint.from_dict(log_cp["deli"])
+            if checkpoint is None or log_deli.log_offset > checkpoint.log_offset:
+                checkpoint = log_deli
+                scribe_log_cp = log_cp["scribe"]
 
         kw = {"clock": clock}
         if client_timeout is not None:
@@ -62,12 +90,13 @@ class LocalOrderer:
         self.broadcaster = BroadcasterLambda(pubsub)
         scribe_cp = db.find_one(
             SCRIBE_CHECKPOINT_COLLECTION, f"{tenant_id}/{document_id}")
+        scribe_state = scribe_log_cp or (scribe_cp["state"] if scribe_cp else None)
         self.scribe = ScribeLambda(
             tenant_id,
             document_id,
             db,
             send_to_deli=self.order,
-            checkpoint=scribe_cp["state"] if scribe_cp else None,
+            checkpoint=scribe_state,
         )
 
         # deli replays the raw topic from 0 and self-skips via its
@@ -98,13 +127,17 @@ class LocalOrderer:
 
     def checkpoint(self) -> None:
         """Persist deli + scribe state (ref: deli checkpointContext.ts,
-        scribe checkpointManager.ts → Mongo)."""
-        self._db.upsert(
-            CHECKPOINT_COLLECTION,
-            f"{self.tenant_id}/{self.document_id}",
-            {"state": self.deli.checkpoint().to_dict()},
+        scribe checkpointManager.ts → Mongo) — to the db and, so a durable
+        log can recover it after full process death, to the log too."""
+        deli_state = self.deli.checkpoint().to_dict()
+        scribe_state = self.scribe.checkpoint_state()
+        key = f"{self.tenant_id}/{self.document_id}"
+        self._db.upsert(CHECKPOINT_COLLECTION, key, {"state": deli_state})
+        self._db.upsert(SCRIBE_CHECKPOINT_COLLECTION, key, {"state": scribe_state})
+        self._log.append(
+            _checkpoint_topic(self.tenant_id, self.document_id),
+            {"deli": deli_state, "scribe": scribe_state},
         )
-        self.scribe.checkpoint()
 
     def _on_sequenced(self, msg: SequencedDocumentMessage) -> None:
         self._log.append(

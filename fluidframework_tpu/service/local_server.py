@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import time
+import uuid
 from typing import Any, Callable, Optional
 
 from ..protocol.messages import (
@@ -102,14 +103,21 @@ class LocalServer:
         auto_drain: bool = True,
         clock: Callable[[], float] = time.time,
         client_timeout: Optional[float] = None,
+        log=None,
     ):
-        self.log = LocalLog()
+        # any object with the LocalLog surface works — pass a DurableLog
+        # to persist the pipeline across process restarts
+        self.log = log if log is not None else LocalLog()
         self.db = InMemoryDb()
         self.pubsub = PubSub()
         self._orderers: dict[str, LocalOrderer] = {}
         self._auto_drain = auto_drain
         self._clock = clock
         self._client_timeout = client_timeout
+        # ids must be unique across SERVER restarts too (a durable log
+        # carries the old incarnation's ops, and clients classify local
+        # vs remote by id), hence the random epoch component
+        self._client_epoch = uuid.uuid4().hex[:6]
         self._client_counter = itertools.count(1)
 
     # ------------------------------------------------------------------ api
@@ -124,7 +132,7 @@ class LocalServer:
         """The connect_document handshake: join the quorum, get a live
         connection primed at the current sequence number."""
         orderer = self._get_orderer(tenant_id, document_id)
-        client_id = f"client-{next(self._client_counter)}"
+        client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
 
         topic = BroadcasterLambda.topic(tenant_id, document_id)

@@ -137,15 +137,16 @@ class ScribeLambda:
 
     # ----------------------------------------------------------- checkpoint
 
+    def checkpoint_state(self) -> dict:
+        return {
+            "protocol": self.protocol.snapshot(),
+            "head": self.last_summary_head,
+            "offset": self.last_offset,
+        }
+
     def checkpoint(self) -> None:
         self._db.upsert(
             SCRIBE_CHECKPOINT_COLLECTION,
             f"{self.tenant_id}/{self.document_id}",
-            {
-                "state": {
-                    "protocol": self.protocol.snapshot(),
-                    "head": self.last_summary_head,
-                    "offset": self.last_offset,
-                }
-            },
+            {"state": self.checkpoint_state()},
         )

@@ -227,15 +227,19 @@ class TpuDocumentApplier:
     def _escalate(self, slot: int, msg, wire_op) -> None:
         """Rebuild the doc on the scalar oracle from its authoritative op
         log and continue host-side (SURVEY §7(e) escape hatch)."""
-        self.host_escalations += 1
         tenant_id, document_id = self._doc_keys[slot]
+        if self._replay_log is None:
+            # degrading to an empty replica would silently lose the doc
+            raise RuntimeError(
+                f"doc {tenant_id}/{document_id} needs host escalation but no "
+                "replay source is configured (set_replay_source)")
+        self.host_escalations += 1
         replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
         self._host_docs[slot] = replica
         self._staged.pop(slot, None)
-        if self._replay_log is not None:
-            for m in self._replay_log(tenant_id, document_id):
-                if m.type == MessageType.OPERATION:
-                    replica.apply_msg(m, local=False)
+        for m in self._replay_log(tenant_id, document_id):
+            if m.type == MessageType.OPERATION:
+                replica.apply_msg(m, local=False)
         if msg is not None:
             self._apply_host(slot, msg, wire_op)
 

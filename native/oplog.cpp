@@ -1,0 +1,206 @@
+// Durable append-only partitioned op log — the librdkafka-role component.
+//
+// Ref role: node-rdkafka/librdkafka carries the ordered, checkpointed
+// message log between the reference's pipeline stages (SURVEY §2.9).
+// Here: one directory per log, one (data, index) file pair per topic.
+// Data file: length-prefixed records; index file: uint64 byte offsets,
+// one per record, so offset->record lookup is O(1) and recovery is a
+// single index scan. Appends are buffered by libc and made durable by
+// oplog_sync (the checkpoint boundary deli/scribe flush on).
+//
+// C ABI (ctypes-friendly), no exceptions across the boundary.
+
+#include <cstdint>
+#include <cstdio>
+#include <cctype>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#ifndef _WIN32
+#include <unistd.h>
+#endif
+#include <vector>
+
+namespace {
+
+struct Topic {
+    FILE* data = nullptr;
+    FILE* index = nullptr;
+    std::vector<uint64_t> offsets;  // byte offset of each record
+    uint64_t data_end = 0;
+};
+
+struct OpLog {
+    std::string dir;
+    std::map<std::string, Topic> topics;
+    std::mutex mu;
+};
+
+bool valid_topic_name(const char* t) {
+    for (const char* p = t; *p; ++p) {
+        if (!(isalnum(*p) || *p == '-' || *p == '_' || *p == '.')) return false;
+    }
+    return *t != 0;
+}
+
+Topic* get_topic(OpLog* log, const char* name) {
+    auto it = log->topics.find(name);
+    if (it != log->topics.end()) return &it->second;
+    if (!valid_topic_name(name)) return nullptr;
+
+    Topic t;
+    std::string base = log->dir + "/" + name;
+    std::string dpath = base + ".data", ipath = base + ".idx";
+    t.data = fopen(dpath.c_str(), "ab+");
+    t.index = fopen(ipath.c_str(), "ab+");
+    if (!t.data || !t.index) {
+        if (t.data) fclose(t.data);
+        if (t.index) fclose(t.index);
+        return nullptr;
+    }
+    // recover the index
+    fseek(t.index, 0, SEEK_SET);
+    uint64_t off;
+    while (fread(&off, sizeof(off), 1, t.index) == 1) t.offsets.push_back(off);
+    fseek(t.data, 0, SEEK_END);
+    t.data_end = (uint64_t)ftell(t.data);
+    // drop torn trailing records (crash mid-append): index entries whose
+    // record extends past the data end. The files MUST be truncated to the
+    // validated extent too — an in-memory-only drop would let the next
+    // append re-expose the stale index entry on a subsequent restart,
+    // shifting every record ordinal.
+    size_t valid = t.offsets.size();
+    uint64_t valid_end = t.data_end;
+    while (valid > 0) {
+        uint64_t last = t.offsets[valid - 1];
+        uint32_t len = 0;
+        if (last + sizeof(len) <= t.data_end) {
+            fseek(t.data, (long)last, SEEK_SET);
+            if (fread(&len, sizeof(len), 1, t.data) == 1 &&
+                last + sizeof(len) + len <= t.data_end) {
+                valid_end = last + sizeof(len) + len;
+                break;
+            }
+        }
+        valid--;
+        valid_end = last;
+    }
+    if (valid < t.offsets.size() || valid_end < t.data_end) {
+        t.offsets.resize(valid);
+        fflush(t.index);
+        fflush(t.data);
+#ifndef _WIN32
+        if (ftruncate(fileno(t.index), (off_t)(valid * sizeof(uint64_t))) != 0 ||
+            ftruncate(fileno(t.data), (off_t)valid_end) != 0) {
+            fclose(t.data);
+            fclose(t.index);
+            return nullptr;
+        }
+#endif
+        t.data_end = valid_end;
+    }
+    auto res = log->topics.emplace(name, std::move(t));
+    return &res.first->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* oplog_open(const char* dir) {
+    if (!dir) return nullptr;
+    mkdir(dir, 0755);  // EEXIST is fine
+    auto* log = new OpLog();
+    log->dir = dir;
+    return log;
+}
+
+void oplog_close(void* handle) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log) return;
+    for (auto& kv : log->topics) {
+        if (kv.second.data) fclose(kv.second.data);
+        if (kv.second.index) fclose(kv.second.index);
+    }
+    delete log;
+}
+
+// Append one record; returns its offset (record ordinal), or -1 on error.
+int64_t oplog_append(void* handle, const char* topic, const void* data,
+                     int64_t len) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !topic || (!data && len > 0) || len < 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    Topic* t = get_topic(log, topic);
+    if (!t) return -1;
+    uint64_t record_start = t->data_end;
+    uint32_t len32 = (uint32_t)len;
+    fseek(t->data, 0, SEEK_END);
+    bool ok = fwrite(&len32, sizeof(len32), 1, t->data) == 1 &&
+              (len == 0 || fwrite(data, 1, (size_t)len, t->data) == (size_t)len);
+    if (ok) {
+        fseek(t->index, 0, SEEK_END);
+        ok = fwrite(&record_start, sizeof(record_start), 1, t->index) == 1;
+    }
+    if (!ok) {
+        // roll the data file back to the last valid extent, or the next
+        // append would index a record that starts inside garbage bytes
+        fflush(t->data);
+#ifndef _WIN32
+        ftruncate(fileno(t->data), (off_t)t->data_end);
+#endif
+        fseek(t->data, 0, SEEK_END);
+        return -1;
+    }
+    t->data_end = record_start + sizeof(len32) + (uint64_t)len;
+    t->offsets.push_back(record_start);
+    return (int64_t)t->offsets.size() - 1;
+}
+
+int64_t oplog_length(void* handle, const char* topic) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !topic) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    Topic* t = get_topic(log, topic);
+    return t ? (int64_t)t->offsets.size() : -1;
+}
+
+// Read record `offset`; returns record length. If it exceeds buflen the
+// buffer is untouched and the needed size is returned (call again).
+// Returns -1 on bad args / unknown record.
+int64_t oplog_read(void* handle, const char* topic, int64_t offset, void* buf,
+                   int64_t buflen) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !topic || offset < 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    Topic* t = get_topic(log, topic);
+    if (!t || (uint64_t)offset >= t->offsets.size()) return -1;
+    uint64_t start = t->offsets[(size_t)offset];
+    uint32_t len = 0;
+    fflush(t->data);
+    fseek(t->data, (long)start, SEEK_SET);
+    if (fread(&len, sizeof(len), 1, t->data) != 1) return -1;
+    if ((int64_t)len > buflen) return (int64_t)len;
+    if (len > 0 && fread(buf, 1, len, t->data) != len) return -1;
+    return (int64_t)len;
+}
+
+// Make everything appended so far durable (fflush + fsync).
+int oplog_sync(void* handle) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    for (auto& kv : log->topics) {
+        fflush(kv.second.data);
+        fflush(kv.second.index);
+#ifndef _WIN32
+        fsync(fileno(kv.second.data));
+        fsync(fileno(kv.second.index));
+#endif
+    }
+    return 0;
+}
+
+}  // extern "C"

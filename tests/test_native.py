@@ -1,0 +1,184 @@
+"""Native C++ components: op log + chunk store, and the durable service.
+
+Skipped wholesale when no g++ toolchain is present (the pure-Python
+in-memory paths cover the same contracts).
+"""
+
+import hashlib
+
+import pytest
+
+from fluidframework_tpu.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain")
+
+
+@pytest.fixture
+def oplog(tmp_path):
+    from fluidframework_tpu.native import NativeOpLog
+
+    log = NativeOpLog(str(tmp_path / "log"))
+    yield log
+    log.close()
+
+
+def test_oplog_append_read_roundtrip(oplog):
+    assert oplog.append("t1", b"hello") == 0
+    assert oplog.append("t1", b"") == 1
+    assert oplog.append("t1", b"x" * 10_000) == 2
+    assert oplog.append("t2", b"other") == 0
+    assert oplog.length("t1") == 3
+    assert oplog.read("t1", 0) == b"hello"
+    assert oplog.read("t1", 1) == b""
+    assert oplog.read("t1", 2) == b"x" * 10_000
+    assert oplog.read("t2", 0) == b"other"
+    with pytest.raises(IndexError):
+        oplog.read("t1", 3)
+
+
+def test_oplog_survives_reopen(tmp_path):
+    from fluidframework_tpu.native import NativeOpLog
+
+    path = str(tmp_path / "log")
+    log = NativeOpLog(path)
+    for i in range(50):
+        log.append("ops", f"record-{i}".encode())
+    log.sync()
+    log.close()
+
+    log2 = NativeOpLog(path)
+    assert log2.length("ops") == 50
+    assert log2.read("ops", 17) == b"record-17"
+    assert log2.append("ops", b"after-restart") == 50
+    log2.close()
+
+
+def test_oplog_truncates_torn_record_durably(tmp_path):
+    from fluidframework_tpu.native import NativeOpLog
+
+    path = tmp_path / "log"
+    log = NativeOpLog(str(path))
+    log.append("t", b"AAAA")
+    log.append("t", b"BBBB")
+    log.sync()
+    log.close()
+    # simulate a crash mid-append: index entry present, data truncated
+    with open(path / "t.idx", "ab") as f:
+        f.write((4 + 4 + 4).to_bytes(8, "little"))  # record 2 start offset
+    with open(path / "t.data", "ab") as f:
+        f.write((4).to_bytes(4, "little") + b"CC")  # torn: 2 of 4 bytes
+
+    log1 = NativeOpLog(str(path))
+    assert log1.length("t") == 2  # torn record dropped
+    assert log1.append("t", b"CCCC") == 2
+    log1.sync()
+    log1.close()
+
+    # SECOND restart: the truncation must have been durable, or the stale
+    # index entry resurrects and shifts every ordinal
+    log2 = NativeOpLog(str(path))
+    assert log2.length("t") == 3
+    assert log2.read("t", 2) == b"CCCC"
+    log2.close()
+
+
+def test_durable_log_escapes_colliding_user_payloads(tmp_path):
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    log = DurableLog(str(tmp_path / "log"))
+    tricky = {"contents": {"_msg": {"user": "data"}, "_esc": 1, "n": [1, {"_msg": 2}]}}
+    log.append("t", tricky)
+    assert log.read("t", 0) == tricky
+    log.close()
+
+
+def test_chunkstore_put_get_dedup(tmp_path):
+    from fluidframework_tpu.native import NativeChunkStore
+
+    store = NativeChunkStore(str(tmp_path / "cas"))
+    data = b"the quick brown fox"
+    h = store.put(data)
+    assert h == hashlib.sha256(data).hexdigest()  # interoperable addressing
+    assert store.get(h) == data
+    assert store.has(h)
+    assert store.put(data) == h  # dedup: same address
+    assert not store.has("0" * 64)
+    with pytest.raises(KeyError):
+        store.get("0" * 64)
+    big = bytes(range(256)) * 1000
+    hb = store.put(big)
+    assert store.get(hb) == big
+    store.close()
+
+
+def test_chunkstore_rejects_traversal_hashes(tmp_path):
+    from fluidframework_tpu.native import NativeChunkStore
+
+    store = NativeChunkStore(str(tmp_path / "cas"))
+    with pytest.raises(KeyError):
+        store.get("../" * 21 + "x")
+    assert not store.has("../../etc/passwd")
+    store.close()
+
+
+def test_message_serialization_roundtrip():
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage, MessageType, SequencedDocumentMessage, TraceHop)
+    from fluidframework_tpu.protocol.serialization import (
+        decode_message, encode_message)
+    from fluidframework_tpu.service.deli import RawMessage
+
+    seq = SequencedDocumentMessage(
+        client_id="c1", sequence_number=7, minimum_sequence_number=3,
+        client_sequence_number=2, reference_sequence_number=5,
+        type=MessageType.OPERATION, contents={"kind": "chanop", "x": [1, 2]},
+        traces=[TraceHop(service="deli", action="sequence", timestamp=1.5)])
+    assert decode_message(encode_message(seq)) == seq
+
+    raw = RawMessage(
+        tenant_id="t", document_id="d", client_id="c1",
+        operation=DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"op": "set"}),
+        timestamp=2.0)
+    assert decode_message(encode_message(raw)) == raw
+
+
+def test_durable_service_survives_process_restart(tmp_path):
+    from fluidframework_tpu.driver import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalServer
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    path = str(tmp_path / "service-log")
+    server = LocalServer(log=DurableLog(path))
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "durable")
+    server.checkpoint_all()
+    server.log.sync()
+    seq_before = server._orderers["t/doc"].deli.sequence_number
+    deltas_before = server.log.length("deltas/t/doc")
+    server.log.close()
+    del server
+
+    # a NEW process: same log directory, fresh everything else. Deli and
+    # scribe restore from the checkpoint record persisted IN the log;
+    # scriptorium rebuilds its delta collection by replaying the durable
+    # deltas topic; no raw op is re-sequenced (no duplicate deltas)
+    server2 = LocalServer(log=DurableLog(path))
+    loader2 = Loader(LocalDocumentServiceFactory(server2))
+    c2 = loader2.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == "durable"
+    orderer = server2._orderers["t/doc"]
+    assert orderer.deli.sequence_number > seq_before  # c2's join came after
+    # replay did not duplicate any pre-restart delta
+    joins_etc_after = server2.log.length("deltas/t/doc") - deltas_before
+    assert joins_etc_after == 1  # exactly c2's join
+    # and the doc is live again
+    s2.insert_text(0, "still ")
+    assert s2.get_text() == "still durable"
