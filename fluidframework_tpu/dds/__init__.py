@@ -15,6 +15,8 @@ from .directory import SharedDirectory
 from .consensus import ConsensusQueue, ConsensusRegisterCollection
 from .ink import Ink, SharedSummaryBlock
 from .matrix import SharedMatrix
+from .sequence import SharedNumberSequence, SharedObjectSequence
+from .intervals import IntervalCollection, SequenceInterval
 
 __all__ = [
     "SharedObject",
@@ -28,6 +30,10 @@ __all__ = [
     "Ink",
     "SharedSummaryBlock",
     "SharedMatrix",
+    "SharedNumberSequence",
+    "SharedObjectSequence",
+    "IntervalCollection",
+    "SequenceInterval",
     "create_channel",
     "load_channel",
     "register_channel_type",

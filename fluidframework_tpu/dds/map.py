@@ -30,14 +30,20 @@ class SharedMap(SharedObject):
     # ----------------------------------------------------------- mutators
 
     def set(self, key: str, value: Any) -> None:
+        prev = (self._kernel.get(key), self._kernel.has(key))
         self._kernel.local_set(key, value)
         self._submit_map_op({"op": "set", "key": key, "value": value})
-        self._emit("valueChanged", {"key": key, "local": True})
+        self._emit("valueChanged", {"key": key, "local": True,
+                                    "previousValue": prev[0],
+                                    "previousExisted": prev[1]})
 
     def delete(self, key: str) -> bool:
+        prev = (self._kernel.get(key), self._kernel.has(key))
         existed = self._kernel.local_delete(key)
         self._submit_map_op({"op": "delete", "key": key})
-        self._emit("valueChanged", {"key": key, "local": True})
+        self._emit("valueChanged", {"key": key, "local": True,
+                                    "previousValue": prev[0],
+                                    "previousExisted": prev[1]})
         return existed
 
     def clear(self) -> None:

@@ -16,6 +16,7 @@ from ..mergetree.client import MergeTreeClient
 from ..mergetree.ops import op_to_wire
 from ..mergetree.references import LocalReference, ReferenceType
 from ..protocol.messages import MessageType, SequencedDocumentMessage
+from .intervals import IntervalCollection
 from .registry import register_channel_type
 from .shared_object import SharedObject
 
@@ -29,6 +30,8 @@ class SharedString(SharedObject):
     def __init__(self, channel_id: str):
         super().__init__(channel_id)
         self.client = MergeTreeClient(DETACHED_ID)
+        self._interval_collections: dict[str, IntervalCollection] = {}
+        self._pending_interval_ops: list[dict] = []
 
     # ------------------------------------------------------------- editing
 
@@ -43,10 +46,11 @@ class SharedString(SharedObject):
         self.submit_local_message(op_to_wire(op))
 
     def remove_text(self, start: int, end: int) -> None:
+        removed = self.get_text()[start:end]
         op = self.client.remove_range_local(start, end)
         self.submit_local_message(op_to_wire(op))
         self._emit("sequenceDelta", {"op": "remove", "start": start, "end": end,
-                                     "local": True})
+                                     "removedText": removed, "local": True})
 
     def annotate_range(self, start: int, end: int, props: dict) -> None:
         op = self.client.annotate_range_local(start, end, props)
@@ -68,9 +72,33 @@ class SharedString(SharedObject):
     def reference_position(self, ref: LocalReference) -> int:
         return self.client.reference_position(ref)
 
+    # ----------------------------------------------------------- intervals
+
+    def get_interval_collection(self, label: str) -> IntervalCollection:
+        """Named collection of sliding ranges over this string (ref:
+        SharedSegmentSequence.getIntervalCollection, sequence.ts)."""
+        if label not in self._interval_collections:
+            self._interval_collections[label] = IntervalCollection(label, self)
+        return self._interval_collections[label]
+
+    def _submit_interval_op(self, wire: dict) -> None:
+        self._pending_interval_ops.append(wire)
+        self.submit_local_message(wire)
+
     # ------------------------------------------------------------ contract
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        contents = msg.contents
+        if isinstance(contents, dict) and contents.get("type") == "interval":
+            coll = self.get_interval_collection(contents["label"])
+            if local:
+                self._pending_interval_ops.pop(0)
+            coll.process(contents, msg, local)
+            # interval msgs still advance the collab window for zamboni
+            self.client.tree.current_seq = max(
+                self.client.tree.current_seq, msg.sequence_number)
+            self.client.tree.update_min_seq(msg.minimum_sequence_number)
+            return
         self.client.apply_msg(msg, local)
         if not local and msg.type == MessageType.OPERATION:
             self._emit("sequenceDelta", {"wire": msg.contents, "local": False})
@@ -78,13 +106,40 @@ class SharedString(SharedObject):
     def resubmit_pending(self) -> None:
         for op in self.client.regenerate_pending_ops():
             self.submit_local_message(op_to_wire(op))
+        pending, self._pending_interval_ops = self._pending_interval_ops, []
+        for wire in pending:
+            # endpoints already slid with local edits: refresh positions
+            wire = dict(wire)
+            if wire["op"] in ("add", "change"):
+                coll = self.get_interval_collection(wire["label"])
+                interval = coll.get(wire["id"])
+                if interval is None and wire["op"] == "change":
+                    continue  # deleted meanwhile: drop the change
+                if interval is not None:
+                    s, e = coll.position(interval)
+                    if wire.get("start") is not None:
+                        wire["start"] = s
+                    if wire.get("end") is not None:
+                        wire["end"] = e
+            self._submit_interval_op(wire)
 
     def on_connect(self, client_id: str) -> None:
         if client_id != self.client.client_id:
             self.client.update_client_id(client_id)
 
     def snapshot(self) -> dict:
-        return self.client.snapshot()
+        return {
+            "mergetree": self.client.snapshot(),
+            "intervals": {
+                label: coll.snapshot()
+                for label, coll in self._interval_collections.items()
+            },
+        }
 
     def load_core(self, snap: dict) -> None:
-        self.client = MergeTreeClient.load(DETACHED_ID, snap)
+        if "mergetree" not in snap:  # pre-intervals snapshot layout
+            self.client = MergeTreeClient.load(DETACHED_ID, snap)
+            return
+        self.client = MergeTreeClient.load(DETACHED_ID, snap["mergetree"])
+        for label, coll_snap in snap.get("intervals", {}).items():
+            self.get_interval_collection(label).load(coll_snap)

@@ -394,12 +394,28 @@ class MergeTreeClient:
     def create_reference(
         self, pos: int, ref_type: ReferenceType = ReferenceType.SLIDE_ON_REMOVE
     ) -> LocalReference:
-        view = self.local_view()
-        idx, offset = self.tree.resolve(pos, view)
-        if idx >= len(self.tree.segments):
+        return self.create_reference_at(pos, self.local_view(), ref_type)
+
+    def create_reference_at(
+        self,
+        pos: int,
+        perspective: Perspective,
+        ref_type: ReferenceType = ReferenceType.SLIDE_ON_REMOVE,
+    ) -> LocalReference:
+        """Create a reference interpreting ``pos`` in an arbitrary view —
+        remote interval ops anchor at the AUTHOR's (refSeq, client)
+        perspective (ref: intervalCollection op apply, sequence pkg)."""
+        idx, offset = self.tree.resolve(pos, perspective)
+        segs = self.tree.segments
+        if offset == 0:
+            # boundary: attach to the first perspective-visible segment at
+            # or after the resolution point
+            while idx < len(segs) and segs[idx].visible_length(perspective) == 0:
+                idx += 1
+        if idx >= len(segs):
             ref = LocalReference(None, 0, ref_type)
         else:
-            seg = self.tree.segments[idx]
+            seg = segs[idx]
             ref = LocalReference(seg, offset, ref_type)
             seg.local_refs.append(ref)
         return ref

@@ -132,6 +132,8 @@ class TpuDocumentApplier:
         wire_op: dict,
     ) -> None:
         """Stage one sequenced merge-tree wire op for batched apply."""
+        if isinstance(wire_op, dict) and wire_op.get("type") == "interval":
+            return  # interval metadata: no effect on text content
         slot = self.slot_of(tenant_id, document_id)
         if slot in self._host_docs:
             self._apply_host(slot, msg, wire_op)

@@ -1,0 +1,161 @@
+"""Framework layer: DataObject lifecycle, undo-redo, interceptions,
+value sequences.
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.framework import (
+    DataObject,
+    DataObjectFactory,
+    UndoRedoStackManager,
+    intercepted_map,
+    intercepted_string,
+)
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+# ------------------------------------------------------------ data object
+
+class Notes(DataObject):
+    def initializing_first_time(self):
+        self.create_channel("text", "shared-string")
+        self.root.set("title", "untitled")
+        self.calls = "first"
+
+    def initializing_from_existing(self):
+        self.calls = "existing"
+
+
+def test_data_object_lifecycle(loader):
+    factory = DataObjectFactory("notes", Notes)
+    c1 = loader.resolve("t", "doc")
+    n1 = factory.create_or_load(c1)
+    assert n1.calls == "first"
+    assert n1.root.get("title") == "untitled"
+    n1.get_channel("text").insert_text(0, "hello")
+
+    c2 = loader.resolve("t", "doc")
+    n2 = factory.create_or_load(c2)
+    assert n2.calls == "existing"
+    assert n2.root.get("title") == "untitled"
+    assert n2.get_channel("text").get_text() == "hello"
+
+
+# --------------------------------------------------------------- undo-redo
+
+def undo_setup(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    s1 = ds.create_channel("text", "shared-string")
+    m1 = ds.create_channel("kv", "shared-map")
+    mgr = UndoRedoStackManager()
+    mgr.attach_string(s1)
+    mgr.attach_map(m1)
+    ds2 = c2.runtime.get_data_store("default")
+    return mgr, s1, m1, ds2.get_channel("text"), ds2.get_channel("kv")
+
+
+def test_undo_redo_string(loader):
+    mgr, s1, m1, s2, m2 = undo_setup(loader)
+    s1.insert_text(0, "hello")
+    mgr.close_current_operation()
+    s1.insert_text(5, " world")
+    mgr.close_current_operation()
+    assert mgr.undo()
+    assert s1.get_text() == s2.get_text() == "hello"
+    assert mgr.undo()
+    assert s1.get_text() == s2.get_text() == ""
+    assert mgr.redo()
+    assert s1.get_text() == s2.get_text() == "hello"
+    assert mgr.redo()
+    assert s1.get_text() == s2.get_text() == "hello world"
+
+
+def test_undo_remove_restores_text(loader):
+    mgr, s1, m1, s2, m2 = undo_setup(loader)
+    s1.insert_text(0, "abcdef")
+    mgr.close_current_operation()
+    s1.remove_text(2, 4)
+    mgr.close_current_operation()
+    assert s1.get_text() == "abef"
+    mgr.undo()
+    assert s1.get_text() == s2.get_text() == "abcdef"
+
+
+def test_undo_slides_past_remote_edits(loader):
+    mgr, s1, m1, s2, m2 = undo_setup(loader)
+    s1.insert_text(0, "base ")
+    mgr.close_current_operation()
+    s1.insert_text(5, "LOCAL")
+    mgr.close_current_operation()
+    s2.insert_text(0, "remote ")  # shifts everything right
+    mgr.undo()  # must remove LOCAL, not whatever now sits at 5..10
+    assert s1.get_text() == s2.get_text() == "remote base "
+
+
+def test_undo_map_and_redo_clear_on_new_edit(loader):
+    mgr, s1, m1, s2, m2 = undo_setup(loader)
+    m1.set("k", 1)
+    mgr.close_current_operation()
+    m1.set("k", 2)
+    mgr.close_current_operation()
+    mgr.undo()
+    assert m1.get("k") == m2.get("k") == 1
+    assert mgr.can_redo
+    m1.set("k", 9)  # fresh edit invalidates the redo future
+    assert not mgr.can_redo
+    mgr.undo()
+    assert m1.get("k") == 1
+    mgr.undo()
+    assert not m1.has("k") and not m2.has("k")
+
+
+# ------------------------------------------------------------ interceptions
+
+def test_interceptions_stamp_attribution(loader):
+    c1 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    s = ds.create_channel("text", "shared-string")
+    m = ds.create_channel("kv", "shared-map")
+    user = {"user": "alice"}
+    si = intercepted_string(s, lambda props: dict(props or {}, **user))
+    mi = intercepted_map(m, lambda k, v: {"value": v, **user})
+    si.insert_text(0, "hi")
+    mi.set("k", 42)
+    # reads pass through to the underlying DDS
+    assert si.get_text() == "hi"
+    assert mi.get("k") == {"value": 42, "user": "alice"}
+    seg = s.client.tree.segments[0]
+    assert seg.props == {"user": "alice"}
+
+
+# ---------------------------------------------------------- value sequences
+
+def test_number_and_object_sequences(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    nums = ds.create_channel("nums", "shared-number-sequence")
+    objs = ds.create_channel("objs", "shared-object-sequence")
+    nums.insert_range(0, [1, 2, 3])
+    nums.insert_range(1, [10])
+    nums.remove_range(0, 1)
+    objs.insert_range(0, [{"a": 1}, {"b": 2}])
+    ds2 = c2.runtime.get_data_store("default")
+    assert ds2.get_channel("nums").get_items() == [10, 2, 3]
+    assert nums.get_items() == [10, 2, 3]
+    assert nums.get_item(1) == 2
+    assert ds2.get_channel("objs").get_items() == [{"a": 1}, {"b": 2}]

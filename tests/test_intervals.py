@@ -1,0 +1,137 @@
+"""Interval collection specs: sliding endpoints, convergence, concurrent
+edits, reconnect rebase, snapshot boot.
+
+Ref: dds/sequence interval tests (intervalCollection.ts semantics) —
+"local references must slide correctly — subtle" (SURVEY §7.7).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def string_pair(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s1.insert_text(0, "0123456789")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    return c1, c2, s1, s2
+
+
+def test_interval_replicates_and_slides(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    ivals1 = s1.get_interval_collection("highlights")
+    ival = ivals1.add(2, 5, {"color": "yellow"})
+    ivals2 = s2.get_interval_collection("highlights")
+    assert len(ivals2) == 1
+    remote = ivals2.get(ival.id)
+    assert ivals2.position(remote) == (2, 5)
+    assert remote.properties == {"color": "yellow"}
+
+    # text inserted before the interval slides it right, on both replicas
+    s2.insert_text(0, "ab")
+    assert ivals1.position(ival) == (4, 7)
+    assert ivals2.position(remote) == (4, 7)
+    # remove spanning the start: endpoint slides to the nearest survivor
+    s1.remove_text(3, 6)
+    assert ivals1.position(ival) == ivals2.position(remote)
+
+
+def test_interval_delete_and_change(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    ivals1 = s1.get_interval_collection("x")
+    a = ivals1.add(1, 3)
+    b = ivals1.add(5, 8)
+    ivals2 = s2.get_interval_collection("x")
+    assert len(ivals2) == 2
+    ivals2.delete(a.id)
+    assert len(ivals1) == 1 and ivals1.get(a.id) is None
+    ivals1.change(b.id, start=0, end=9, props={"tag": "wide"})
+    rb = ivals2.get(b.id)
+    assert ivals2.position(rb) == (0, 9)
+    assert rb.properties == {"tag": "wide"}
+
+
+def test_interval_concurrent_change_local_wins(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    i1 = s1.get_interval_collection("x")
+    ival = i1.add(2, 4)
+    i2 = s2.get_interval_collection("x")
+    server._auto_drain = False
+    i1.change(ival.id, start=0)
+    i2.change(ival.id, start=6)  # later in total order → wins
+    server.drain()
+    assert i1.position(i1.get(ival.id)) == i2.position(i2.get(ival.id))
+    assert i1.position(i1.get(ival.id))[0] == 6
+
+
+def test_interval_anchors_at_author_perspective(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    i1 = s1.get_interval_collection("x")
+    i2 = s2.get_interval_collection("x")
+    server._auto_drain = False
+    s1.insert_text(0, "XYZ")  # shifts everything right by 3 (unseen by c2)
+    i2.add(4, 6)  # c2 means chars '4'..'6' of "0123456789"
+    server.drain()
+    # both replicas agree AND the interval covers what c2 meant
+    ival1 = next(iter(i1))
+    ival2 = next(iter(i2))
+    assert i1.position(ival1) == i2.position(ival2) == (7, 9)
+
+
+def test_interval_overlapping_query(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    ic = s1.get_interval_collection("x")
+    a = ic.add(0, 2)
+    b = ic.add(5, 8)
+    hits = ic.find_overlapping(1, 4)
+    assert [i.id for i in hits] == [a.id]
+    hits = ic.find_overlapping(0, 9)
+    assert {i.id for i in hits} == {a.id, b.id}
+
+
+def test_interval_reconnect_resubmits_with_rebased_positions(server, loader):
+    c1, c2, s1, s2 = string_pair(loader)
+    i1 = s1.get_interval_collection("x")
+    i2 = s2.get_interval_collection("x")
+    c1.disconnect()
+    ival = i1.add(3, 5)  # pending while offline
+    s2.insert_text(0, "PRE-")  # remote shift lands first
+    c1.reconnect()
+    assert len(i2) == 1
+    r = i2.get(ival.id)
+    assert i2.position(r) == i1.position(ival) == (7, 9)
+
+
+def test_intervals_survive_summary_boot(server, loader):
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    c1, c2, s1, s2 = string_pair(loader)
+    sm = SummaryManager(c1, max_ops=10_000)
+    ic = s1.get_interval_collection("marks")
+    ival = ic.add(2, 6, {"kind": "comment"})
+    sm.summarize_now()
+
+    c3 = loader.resolve("t", "doc")
+    s3 = c3.runtime.get_data_store("default").get_channel("text")
+    i3 = s3.get_interval_collection("marks")
+    assert len(i3) == 1
+    r = i3.get(ival.id)
+    assert i3.position(r) == (2, 6)
+    assert r.properties == {"kind": "comment"}
+    # and live: slides with post-boot edits
+    s3.insert_text(0, "zz")
+    assert i3.position(r) == (4, 8)
