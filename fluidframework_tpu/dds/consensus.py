@@ -10,6 +10,7 @@ linearizability falls out of the total order + collaboration window.
 from __future__ import annotations
 
 import itertools
+import uuid
 from typing import Any, Optional
 
 from ..protocol.messages import SequencedDocumentMessage
@@ -103,19 +104,24 @@ class ConsensusQueue(SharedObject):
         self._in_flight: dict[str, dict] = {}  # item id → {"value", "client"}
         self._pending_ops: list[dict] = []
         self._uid = itertools.count()
+        # ids minted before attach must still be globally unique — a
+        # literal 'detached' prefix would collide across replicas
+        self._detached_token = f"detached-{uuid.uuid4().hex[:12]}"
 
     # ---------------------------------------------------------------- api
 
+    def _mint_id(self) -> str:
+        return f"{self.client_id or self._detached_token}:{next(self._uid)}"
+
     def add(self, value: Any) -> None:
-        op = {"op": "add", "value": value,
-              "id": f"{self.client_id or 'detached'}:{next(self._uid)}"}
+        op = {"op": "add", "value": value, "id": self._mint_id()}
         self._pending_ops.append(op)
         self.submit_local_message(op)
 
     def acquire(self) -> str:
         """Request the queue head. Returns a ticket; listen for
         "acquired" events or poll :meth:`holding` for the outcome."""
-        ticket = f"{self.client_id or 'detached'}:{next(self._uid)}"
+        ticket = self._mint_id()
         op = {"op": "acquire", "id": ticket}
         self._pending_ops.append(op)
         self.submit_local_message(op)
@@ -171,7 +177,10 @@ class ConsensusQueue(SharedObject):
         elif kind == "release":
             entry = self._in_flight.pop(op["id"], None)
             if entry is not None:
-                self._items.insert(0, {"id": op["id"], "value": entry["value"]})
+                # released items re-add at the BACK (ref:
+                # consensusOrderedCollection removeClient/release), which
+                # also keeps multi-item releases in FIFO order
+                self._items.append({"id": op["id"], "value": entry["value"]})
                 self._emit("localRelease", {"itemId": op["id"]})
 
     def on_member_removed(self, client_id: str) -> None:
@@ -179,7 +188,7 @@ class ConsensusQueue(SharedObject):
         sequenced leave every replica processes)."""
         for iid in [i for i, e in self._in_flight.items() if e["client"] == client_id]:
             entry = self._in_flight.pop(iid)
-            self._items.insert(0, {"id": iid, "value": entry["value"]})
+            self._items.append({"id": iid, "value": entry["value"]})
 
     def resubmit_pending(self) -> None:
         for op in self._pending_ops:

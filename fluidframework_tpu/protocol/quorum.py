@@ -176,10 +176,14 @@ class ProtocolOpHandler:
         self.sequence_number = sequence_number
         self.quorum = quorum or Quorum()
 
-    def process_message(self, message: SequencedDocumentMessage, local: bool = False) -> None:
+    def process_message(self, message: SequencedDocumentMessage, local: bool = False) -> bool:
+        """Apply one sequenced message. Returns False when the message was
+        a duplicate below the head (idempotent redelivery), True when it
+        was applied — callers with side effects beyond the replica (e.g.
+        scribe's summarize handling) must branch on this."""
         if message.sequence_number <= self.sequence_number and message.sequence_number != 0:
             # duplicate delivery — the stream is idempotent below our head
-            return
+            return False
         if message.sequence_number != self.sequence_number + 1:
             # a gap means the caller's reorder buffer failed; processing past
             # it would silently drop ops and diverge the replica (the
@@ -228,6 +232,7 @@ class ProtocolOpHandler:
         self.quorum.update_minimum_sequence_number(
             self.minimum_sequence_number, self.sequence_number
         )
+        return True
 
     def snapshot(self) -> dict:
         return {

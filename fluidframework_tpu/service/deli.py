@@ -123,11 +123,16 @@ class DeliLambda:
         checkpoint: Optional[DeliCheckpoint] = None,
         client_timeout: float = DEFAULT_CLIENT_TIMEOUT,
         clock: Callable[[], float] = time.time,
+        send_raw: Optional[Callable[["RawMessage"], None]] = None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
         self._send = send_sequenced
         self._nack = send_nack
+        # deli → raw-topic backchannel (ref: deli sendToAlfred :631) for
+        # control messages that must be ticketed deterministically on
+        # crash replay (idle-eviction leaves)
+        self._send_raw = send_raw
         self._clock = clock
         self._client_timeout = client_timeout
         cp = checkpoint or DeliCheckpoint()
@@ -166,16 +171,39 @@ class DeliLambda:
 
     def check_idle_clients(self) -> None:
         """Expire clients idle past the timeout so the msn can advance
-        (ref: deli lambda checkIdleClients / ClientSequenceTimeout)."""
+        (ref: deli lambda checkIdleClients / ClientSequenceTimeout).
+
+        Leaves route through the raw-ops log (``send_raw``, the reference's
+        sendToAlfred backchannel) rather than being sequenced directly: a
+        crash after eviction but before a checkpoint must replay raw ops
+        into the SAME sequence numbers already persisted/broadcast, which
+        only holds if the eviction itself is a raw-log record. ``_ticket``'s
+        duplicate-leave check makes redelivery idempotent."""
         now = self._clock()
         for client_id in [
             c.client_id
             for c in self.clients.values()
             if c.can_evict and now - c.last_update > self._client_timeout
         ]:
-            self._sequence_system(
-                MessageType.CLIENT_LEAVE, {"clientId": client_id}
-            )
+            if self._send_raw is not None:
+                self._send_raw(
+                    RawMessage(
+                        tenant_id=self.tenant_id,
+                        document_id=self.document_id,
+                        client_id=None,
+                        operation=DocumentMessage(
+                            client_sequence_number=-1,
+                            reference_sequence_number=-1,
+                            type=MessageType.CLIENT_LEAVE,
+                            contents={"clientId": client_id},
+                        ),
+                        timestamp=now,
+                    )
+                )
+            else:  # no raw backchannel wired (bare-lambda unit tests)
+                self._sequence_system(
+                    MessageType.CLIENT_LEAVE, {"clientId": client_id}, now
+                )
 
     def close(self) -> None:
         pass
@@ -206,20 +234,20 @@ class DeliLambda:
                 can_evict=content.get("canEvict", True),
                 detail=content.get("detail"),
             )
-            self._sequence_system(MessageType.CLIENT_JOIN, content)
+            self._sequence_system(MessageType.CLIENT_JOIN, content, now)
             return
 
         if op.type == MessageType.CLIENT_LEAVE:
             client_id = (op.contents or {}).get("clientId")
             if client_id not in self.clients:
                 return  # duplicate leave
-            self._sequence_system(MessageType.CLIENT_LEAVE, op.contents)
+            self._sequence_system(MessageType.CLIENT_LEAVE, op.contents, now)
             return
 
         if raw.client_id is None:
             # other server-originated messages (scribe's summary ack/nack,
             # control) sequence without client bookkeeping
-            self._sequence_system(op.type, op.contents)
+            self._sequence_system(op.type, op.contents, now)
             return
 
         # client-originated: must be joined
@@ -292,8 +320,14 @@ class DeliLambda:
             )
         )
 
-    def _sequence_system(self, type: MessageType, contents: Any) -> None:
-        """Sequence a server-generated message (join/leave/noClient)."""
+    def _sequence_system(
+        self, type: MessageType, contents: Any, timestamp: Optional[float] = None
+    ) -> None:
+        """Sequence a server-generated message (join/leave/noClient).
+
+        ``timestamp`` is the raw message's timestamp when ticketing from
+        the log — replay must reproduce byte-identical sequenced records,
+        so the wall clock is only a fallback for direct (non-log) calls."""
         if type == MessageType.CLIENT_LEAVE:
             self.clients.pop((contents or {}).get("clientId"), None)
         self.sequence_number += 1
@@ -306,7 +340,7 @@ class DeliLambda:
                 reference_sequence_number=-1,
                 type=type,
                 contents=contents,
-                timestamp=self._clock(),
+                timestamp=self._clock() if timestamp is None else timestamp,
                 traces=[TraceHop(service="deli", action="sequence")],
             )
         )

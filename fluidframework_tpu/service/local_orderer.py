@@ -84,6 +84,7 @@ class LocalOrderer:
             send_sequenced=self._on_sequenced,
             send_nack=self._on_nack,
             checkpoint=checkpoint,
+            send_raw=self.order,
             **kw,
         )
         self.scriptorium = ScriptoriumLambda(db)

@@ -59,8 +59,13 @@ class ScribeLambda:
             return  # replay after restart
         self.last_offset = message.offset
         msg: SequencedDocumentMessage = message.value["message"]
-        self.protocol.process_message(msg)
-        if msg.type == MessageType.SUMMARIZE:
+        # deli crash-replay re-appends already-sequenced records at NEW
+        # topic offsets, so the offset gate above doesn't catch them;
+        # process_message dedupes by seq and reports it — an already-acked
+        # summarize must not re-run _handle_summarize (it would emit a
+        # spurious nack: parent no longer matches head)
+        applied = self.protocol.process_message(msg)
+        if msg.type == MessageType.SUMMARIZE and applied:
             self._handle_summarize(msg)
 
     def close(self) -> None:

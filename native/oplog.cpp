@@ -64,6 +64,22 @@ Topic* get_topic(OpLog* log, const char* name) {
     fseek(t.index, 0, SEEK_SET);
     uint64_t off;
     while (fread(&off, sizeof(off), 1, t.index) == 1) t.offsets.push_back(off);
+    // a torn trailing PARTIAL index entry (crash mid-index-write) must be
+    // cut even when every complete entry validates against the data extent
+    // below — otherwise the next append lands misaligned after the ragged
+    // tail and silently corrupts the ordinals of later records
+    fseek(t.index, 0, SEEK_END);
+    uint64_t index_bytes = (uint64_t)ftell(t.index);
+    if (index_bytes != t.offsets.size() * sizeof(uint64_t)) {
+#ifndef _WIN32
+        if (ftruncate(fileno(t.index),
+                      (off_t)(t.offsets.size() * sizeof(uint64_t))) != 0) {
+            fclose(t.data);
+            fclose(t.index);
+            return nullptr;
+        }
+#endif
+    }
     fseek(t.data, 0, SEEK_END);
     t.data_end = (uint64_t)ftell(t.data);
     // drop torn trailing records (crash mid-append): index entries whose

@@ -353,3 +353,29 @@ def test_summary_block_travels_via_snapshot_only(server, loader):
     c2 = loader.resolve("t", "doc")
     sb2 = c2.runtime.get_data_store("default").get_channel("sb")
     assert sb2.get("stats") == {"count": 7}
+
+
+def test_queue_multi_release_preserves_fifo(server, loader):
+    """Released items re-add at the BACK in release order (ADVICE r1; ref
+    ConsensusOrderedCollection re-adds to the back, not the head)."""
+    c1, c2, a, b = pair(loader, "consensus-queue")
+    for v in ["w1", "w2", "w3"]:
+        a.add(v)
+    a.acquire()
+    a.acquire()
+    held = [iid for iid, _ in a.holding()]
+    assert [v for _, v in a.holding()] == ["w1", "w2"]
+    for iid in held:
+        a.release(iid)
+    # w3 was never acquired; released w1, w2 queue BEHIND it, in order
+    assert a.peek_values() == b.peek_values() == ["w3", "w1", "w2"]
+
+
+def test_queue_holder_leave_requeues_at_back(server, loader):
+    c1, c2, a, b = pair(loader, "consensus-queue")
+    for v in ["w1", "w2"]:
+        a.add(v)
+    b.acquire()
+    assert [v for _, v in b.holding()] == ["w1"]
+    c2.disconnect()  # holder leaves → its items requeue deterministically
+    assert a.peek_values() == ["w2", "w1"]

@@ -182,3 +182,35 @@ def test_durable_service_survives_process_restart(tmp_path):
     # and the doc is live again
     s2.insert_text(0, "still ")
     assert s2.get_text() == "still durable"
+
+
+def test_oplog_truncates_torn_partial_index_entry(tmp_path):
+    """A torn trailing PARTIAL index entry (not a multiple of 8 bytes) must
+    be cut on recovery even when all complete entries validate — else the
+    next append writes a misaligned index entry and later restarts corrupt
+    every subsequent ordinal (ADVICE r1, oplog.cpp)."""
+    from fluidframework_tpu.native import NativeOpLog
+
+    path = tmp_path / "log"
+    log = NativeOpLog(str(path))
+    log.append("t", b"AAAA")
+    log.append("t", b"BBBB")
+    log.sync()
+    log.close()
+    # crash persisted 3 bytes of a new index entry but none of its data:
+    # the 2 complete entries still match the data extent exactly
+    with open(path / "t.idx", "ab") as f:
+        f.write(b"\x10\x00\x00")
+
+    log1 = NativeOpLog(str(path))
+    assert log1.length("t") == 2
+    assert log1.append("t", b"CCCC") == 2
+    log1.sync()
+    log1.close()
+
+    log2 = NativeOpLog(str(path))
+    assert log2.length("t") == 3
+    assert log2.read("t", 0) == b"AAAA"
+    assert log2.read("t", 1) == b"BBBB"
+    assert log2.read("t", 2) == b"CCCC"
+    log2.close()

@@ -226,3 +226,43 @@ def test_independent_documents_have_independent_orders():
     assert server.get_deltas("t", "docA", 0, 100)[-1].sequence_number == 2
     assert server.get_deltas("t", "docB", 0, 100)[-1].sequence_number == 2
     assert all(m.sequence_number <= 2 for m in ra)
+
+
+def test_idle_eviction_rides_raw_log_for_deterministic_replay():
+    """Idle-eviction leaves must be raw-log records so a crash after the
+    eviction replays into identical sequence numbers (ADVICE r1, deli.py)."""
+    clock = FakeClock()
+    server = LocalServer(clock=clock, client_timeout=60.0)
+    c1, _, _, _ = make_client(server)
+    c2, _, _, _ = make_client(server)
+    c1.submit([op(1, 1)])
+    clock.now += 120
+    c2.submit([op(1, 1)])
+    server.expire_idle_clients()  # evicts c1 via the raw topic
+
+    orderer = server._orderers["t/d"]
+    seq_after_evict = orderer.deli.sequence_number
+    deltas_before = [
+        (m.sequence_number, m.type, m.client_id)
+        for m in server.get_deltas("t", "d", 0, 10**6)
+    ]
+    # the leave is in the raw log...
+    raw_types = [
+        orderer._log.read(orderer.raw_topic, i).operation.type
+        for i in range(orderer._log.length(orderer.raw_topic))
+    ]
+    assert MessageType.CLIENT_LEAVE in raw_types
+
+    # ...so an UN-checkpointed restart (crash: no orderer.checkpoint())
+    # replays the raw topic into the SAME ticketing: same head seq, no
+    # duplicate/new records
+    server._orderers.pop("t/d").close()
+    orderer2 = server._get_orderer("t", "d")
+    server.drain()
+    assert orderer2.deli.sequence_number == seq_after_evict
+    deltas_after = [
+        (m.sequence_number, m.type, m.client_id)
+        for m in server.get_deltas("t", "d", 0, 10**6)
+    ]
+    assert deltas_after == deltas_before
+    assert c1.client_id not in orderer2.deli.clients

@@ -176,3 +176,64 @@ def test_boot_from_summary_sequence_numbers_align(loader):
     st2.insert_text(5, "!")
     assert st.get_text() == st2.get_text() == "hello!"
     assert c2.protocol.sequence_number == c2.delta_manager.last_processed_seq
+
+
+def test_deli_crash_replay_does_not_spuriously_nack_acked_summary(server, loader):
+    """Deli crash-replay re-appends already-sequenced records at NEW topic
+    offsets; scribe must not re-run _handle_summarize for the duplicate
+    summarize (it would nack: parent no longer matches head). ADVICE r1."""
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=2)
+    s = c1.runtime.create_data_store("default").create_channel("text", "shared-string")
+    s.insert_text(0, "hello")
+    s.insert_text(5, " world")
+    assert sm.summaries_acked >= 1
+    acked_before = sm.summaries_acked
+    head_before = server._orderers["t/doc"]._db  # keep db alive across restart
+
+    nack_count_before = sum(
+        1 for m in server.get_deltas("t", "doc", 0, 10**6)
+        if m.type == MessageType.SUMMARY_NACK)
+
+    # crash the orderer without checkpointing: deli replays the raw topic
+    # and re-emits every sequenced record at new deltas-topic offsets
+    server._orderers.pop("t/doc").close()
+    server._get_orderer("t", "doc")
+    server.drain()
+
+    nack_count_after = sum(
+        1 for m in server.get_deltas("t", "doc", 0, 10**6)
+        if m.type == MessageType.SUMMARY_NACK)
+    assert nack_count_after == nack_count_before
+    assert sm.summaries_acked == acked_before
+
+
+def test_scribe_skips_duplicate_summarize_at_new_offset():
+    """Unit-level: a live scribe that sees the same sequenced summarize
+    again at a NEW topic offset (deli crash-replay) must not re-validate
+    it — re-running would nack because the head already advanced."""
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage, SequencedDocumentMessage)
+    from fluidframework_tpu.service.core import (
+        InMemoryDb, QueuedMessage, summary_versions_collection)
+    from fluidframework_tpu.service.scribe import ScribeLambda
+
+    db = InMemoryDb()
+    db.upsert(summary_versions_collection("t", "d"), "h1",
+              {"id": "h1", "parent": None, "acked": False})
+    sent = []
+    scribe = ScribeLambda("t", "d", db, send_to_deli=sent.append)
+
+    summarize = SequencedDocumentMessage(
+        client_id="c1", sequence_number=1, minimum_sequence_number=0,
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.SUMMARIZE,
+        contents={"handle": "h1", "parent": None, "head": 1})
+    scribe.handler(QueuedMessage(topic="deltas/t/d", partition=0, offset=0, value={"message": summarize}))
+    assert [m.operation.type for m in sent] == [MessageType.SUMMARY_ACK]
+    assert scribe.last_summary_head == "h1"
+
+    # deli replay appended the same record again at offset 1
+    scribe.handler(QueuedMessage(topic="deltas/t/d", partition=0, offset=1, value={"message": summarize}))
+    assert [m.operation.type for m in sent] == [MessageType.SUMMARY_ACK]
+    assert scribe.last_summary_head == "h1"
