@@ -27,19 +27,21 @@ def main() -> None:
     D, S, K, NB = 512, 512, 32, 4  # docs × slots × ops/dispatch × dispatches
     rng = np.random.default_rng(42)
 
+    from fluidframework_tpu.ops.apply import wave_min_seq
+
     @jax.jit
-    def step(state, ops, min_seq):
+    def step(state, ops):
         state = apply_ops_batch(state, ops)
-        return compact_batch(state, jnp.broadcast_to(min_seq, state.count.shape))
+        return compact_batch(state, wave_min_seq(ops))
 
     state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
     # one continuous valid stream of K*NB ops per doc, split into NB dispatches
-    stream = generate_batch_ops(rng, D, K * NB, remove_fraction=0.45, max_insert=8)
+    stream = generate_batch_ops(
+        rng, D, K * NB, remove_fraction=0.4, annotate_fraction=0.1, max_insert=8)
     batches = [jnp.asarray(stream[:, i * K : (i + 1) * K]) for i in range(NB)]
-    min_seq = jnp.asarray(0, jnp.int32)
 
     # compile + warm up
-    state = jax.block_until_ready(step(state, batches[0], min_seq))
+    state = jax.block_until_ready(step(state, batches[0]))
 
     n_rounds = 8
     fresh = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
@@ -48,7 +50,7 @@ def main() -> None:
     for _ in range(n_rounds):
         cur = fresh  # streams are generated against an empty doc
         for ops in batches:
-            cur = step(cur, ops, min_seq)
+            cur = step(cur, ops)
         finals.append(cur.count)
     jax.block_until_ready(finals)
     dt = time.perf_counter() - t0

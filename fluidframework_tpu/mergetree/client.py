@@ -118,6 +118,18 @@ class MergeTreeClient:
     def get_length(self) -> int:
         return self.tree.visible_length(self.local_view())
 
+    def get_properties_at(self, pos: int) -> dict:
+        """Properties of the visible character at ``pos`` in the local view
+        (ref: getPropertiesAtPosition, merge-tree client.ts)."""
+        view = self.local_view()
+        cum = 0
+        for seg in self.tree.segments:
+            n = seg.visible_length(view)
+            if cum <= pos < cum + n:
+                return dict(seg.props)
+            cum += n
+        raise IndexError(pos)
+
     # -- local ops (optimistic apply; caller submits returned op) --------
     def insert_text_local(self, pos: int, text: str, props: Optional[dict] = None) -> InsertOp:
         self.local_seq += 1

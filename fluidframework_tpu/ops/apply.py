@@ -9,7 +9,13 @@ Server-side invariants that make this simple (see ops/__init__ docstring):
 ops arrive in sequence order, so every existing stamp is below the incoming
 seq — the concurrent-insert tie-break ("higher seq leftward",
 mergeTree.ts:2281 breakTie) reduces to inserting at the EARLIEST boundary,
-and overlapping removes keep the earliest stamp automatically.
+and overlapping removes keep the earliest stamp automatically. Annotate
+LWW-per-key (segmentPropertiesManager.ts) likewise reduces to in-order
+overwrite of the per-slot property table.
+
+Every op carries the msn deli stamped on its sequenced message (F_MSN), so
+zamboni compaction can run fused after each wave with the exact per-doc
+collaboration-window floor — no host-side msn bookkeeping.
 
 Oracle parity is enforced by tests/test_kernel_vs_oracle.py on fuzzed op
 streams (the TPU-build analog of PartialSequenceLengths.options.verify,
@@ -23,16 +29,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .doc_state import NO_SEQ, DocState
+from .doc_state import NO_KEY, NO_SEQ, DocState
 
 NO_CLIENT = -1
+NO_VAL = -1  # annotate value id meaning "delete this key"
 
 # op vector layout (int32[OP_FIELDS])
 OP_NOOP = 0
 OP_INSERT = 1
 OP_REMOVE = 2
-F_TYPE, F_POS, F_END, F_SEQ, F_REFSEQ, F_CLIENT, F_TLEN, F_TSTART = range(8)
-OP_FIELDS = 8
+OP_ANNOTATE = 3
+(
+    F_TYPE,
+    F_POS,
+    F_END,
+    F_SEQ,
+    F_REFSEQ,
+    F_CLIENT,
+    F_TLEN,
+    F_TSTART,
+    F_MSN,
+    F_FLAGS,
+    F_KEY,
+    F_VAL,
+) = range(12)
+OP_FIELDS = 12
 
 
 def make_op(
@@ -44,11 +65,17 @@ def make_op(
     client: int = 0,
     text_len: int = 0,
     text_start: int = 0,
+    msn: int = 0,
+    flags: int = 0,
+    key: int = 0,
+    val: int = 0,
 ) -> np.ndarray:
     v = np.zeros(OP_FIELDS, np.int32)
     v[F_TYPE], v[F_POS], v[F_END] = type, pos, end
     v[F_SEQ], v[F_REFSEQ], v[F_CLIENT] = seq, ref_seq, client
     v[F_TLEN], v[F_TSTART] = text_len, text_start
+    v[F_MSN], v[F_FLAGS] = msn, flags
+    v[F_KEY], v[F_VAL] = key, val
     return v
 
 
@@ -79,17 +106,25 @@ def _visibility(state: DocState, ref_seq, client, count=None):
     return vis, vlen, cum
 
 
+_SLOT_FIELDS = (
+    "length",
+    "text_start",
+    "flags",
+    "ins_seq",
+    "ins_client",
+    "rem_seq",
+    "rem_client_a",
+    "rem_client_b",
+    "prop_key",
+    "prop_val",
+)
+
+
 def _gather(state: DocState, src, **overrides) -> dict:
+    """Gather every per-slot field along the slot axis (2-D prop tables
+    gather whole rows)."""
     fields = {}
-    for name in (
-        "length",
-        "text_start",
-        "ins_seq",
-        "ins_client",
-        "rem_seq",
-        "rem_client_a",
-        "rem_client_b",
-    ):
+    for name in _SLOT_FIELDS:
         fields[name] = getattr(state, name)[src]
     fields.update(overrides)
     return fields
@@ -122,6 +157,7 @@ def _apply_insert(state: DocState, op) -> DocState:
     head = split & (i == j)
     tail = split & (i == idx + 1)
     new = i == idx
+    new2 = new[:, None]  # broadcast over the prop-table axis
     length = jnp.where(head, o, f["length"])
     length = jnp.where(tail, state.length[j] - o, length)
     length = jnp.where(new, jnp.where(tlen > 0, tlen, 1), length)
@@ -133,11 +169,14 @@ def _apply_insert(state: DocState, op) -> DocState:
     out = DocState(
         length=length,
         text_start=text_start,
+        flags=jnp.where(new, op[F_FLAGS], f["flags"]),
         ins_seq=jnp.where(new, seq, f["ins_seq"]),
         ins_client=jnp.where(new, client, f["ins_client"]),
         rem_seq=jnp.where(new, NO_SEQ, f["rem_seq"]),
         rem_client_a=jnp.where(new, NO_CLIENT, f["rem_client_a"]),
         rem_client_b=jnp.where(new, NO_CLIENT, f["rem_client_b"]),
+        prop_key=jnp.where(new2, NO_KEY, f["prop_key"]),
+        prop_val=jnp.where(new2, 0, f["prop_val"]),
         count=new_count,
         overflow=state.overflow | bad,
     )
@@ -146,7 +185,8 @@ def _apply_insert(state: DocState, op) -> DocState:
 
 def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
     """Split the segment strictly containing visible position ``pos``
-    (no-op when pos falls on a boundary)."""
+    (no-op when pos falls on a boundary). Both halves keep identical
+    stamps, flags, and properties (ref: BaseSegment.splitAt)."""
     S = state.max_slots
     vis, vlen, cum = _visibility(state, ref_seq, client)
     inside = vis & (cum < pos) & (pos < cum + vlen)
@@ -165,11 +205,14 @@ def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
     out = DocState(
         length=length,
         text_start=text_start,
+        flags=f["flags"],
         ins_seq=f["ins_seq"],
         ins_client=f["ins_client"],
         rem_seq=f["rem_seq"],
         rem_client_a=f["rem_client_a"],
         rem_client_b=f["rem_client_b"],
+        prop_key=f["prop_key"],
+        prop_val=f["prop_val"],
         count=state.count + 1,
         overflow=state.overflow | (has & (state.count + 1 > S)),
     )
@@ -199,13 +242,68 @@ def _apply_remove(state: DocState, op) -> DocState:
     out = DocState(
         length=st.length,
         text_start=st.text_start,
+        flags=st.flags,
         ins_seq=st.ins_seq,
         ins_client=st.ins_client,
         rem_seq=jnp.where(fresh, seq, st.rem_seq),
         rem_client_a=jnp.where(fresh, client, st.rem_client_a),
         rem_client_b=jnp.where(add_b, client, st.rem_client_b),
+        prop_key=st.prop_key,
+        prop_val=st.prop_val,
         count=st.count,
         overflow=st.overflow | jnp.any(third) | bad,
+    )
+    return _select_state(bad, state, out)
+
+
+def _apply_annotate(state: DocState, op) -> DocState:
+    """Set ONE property (key, value) on visible span [start, end) — the
+    tensorized annotateRange (mergeTree.ts:2598). Multi-key annotates are
+    staged as one op per key. ``val == NO_VAL`` deletes the key (frees its
+    table slot). In-order apply makes per-key LWW automatic."""
+    start, end = op[F_POS], op[F_END]
+    ref_seq, client = op[F_REFSEQ], op[F_CLIENT]
+    key, val = op[F_KEY], op[F_VAL]
+    P = state.max_props
+
+    _, vlen0, _ = _visibility(state, ref_seq, client)
+    bad = (end > jnp.sum(vlen0)) | (end <= start) | (state.count + 2 > state.max_slots)
+
+    st = _split_at(state, start, ref_seq, client)
+    st = _split_at(st, end, ref_seq, client)
+
+    vis, vlen, cum = _visibility(st, ref_seq, client)
+    covered = vis & (cum >= start) & (cum + vlen <= end)
+
+    match = st.prop_key == key  # [S, P]
+    has_key = jnp.any(match, axis=-1)
+    empty = st.prop_key == NO_KEY
+    has_empty = jnp.any(empty, axis=-1)
+    tgt = jnp.where(has_key, jnp.argmax(match, axis=-1), jnp.argmax(empty, axis=-1))
+
+    is_delete = val == NO_VAL
+    do_write = covered & (has_key | (~is_delete & has_empty))
+    onehot = (jnp.arange(P, dtype=jnp.int32)[None, :] == tgt[:, None]) & do_write[
+        :, None
+    ]
+    prop_key = jnp.where(onehot, jnp.where(is_delete, NO_KEY, key), st.prop_key)
+    prop_val = jnp.where(onehot, jnp.where(is_delete, 0, val), st.prop_val)
+    # a slot that needs a (P+1)th distinct key cannot hold it → escalate
+    table_full = jnp.any(covered & ~has_key & ~has_empty & ~is_delete)
+
+    out = DocState(
+        length=st.length,
+        text_start=st.text_start,
+        flags=st.flags,
+        ins_seq=st.ins_seq,
+        ins_client=st.ins_client,
+        rem_seq=st.rem_seq,
+        rem_client_a=st.rem_client_a,
+        rem_client_b=st.rem_client_b,
+        prop_key=prop_key,
+        prop_val=prop_val,
+        count=st.count,
+        overflow=st.overflow | table_full | bad,
     )
     return _select_state(bad, state, out)
 
@@ -216,11 +314,14 @@ def _select_state(pred, a: DocState, b: DocState) -> DocState:
     return DocState(
         length=take(a.length, b.length),
         text_start=take(a.text_start, b.text_start),
+        flags=take(a.flags, b.flags),
         ins_seq=take(a.ins_seq, b.ins_seq),
         ins_client=take(a.ins_client, b.ins_client),
         rem_seq=take(a.rem_seq, b.rem_seq),
         rem_client_a=take(a.rem_client_a, b.rem_client_a),
         rem_client_b=take(a.rem_client_b, b.rem_client_b),
+        prop_key=take(a.prop_key, b.prop_key),
+        prop_val=take(a.prop_val, b.prop_val),
         count=take(a.count, b.count),
         overflow=b.overflow,  # sticky: set by whichever path ran
     )
@@ -229,8 +330,8 @@ def _select_state(pred, a: DocState, b: DocState) -> DocState:
 def apply_op(state: DocState, op) -> DocState:
     """Apply one sequenced op vector (int32[OP_FIELDS]) to one doc."""
     return lax.switch(
-        jnp.clip(op[F_TYPE], 0, 2),
-        [lambda s, o: s, _apply_insert, _apply_remove],
+        jnp.clip(op[F_TYPE], 0, 3),
+        [lambda s, o: s, _apply_insert, _apply_remove, _apply_annotate],
         state,
         op,
     )
@@ -254,6 +355,17 @@ def apply_ops_scan(state: DocState, ops) -> DocState:
 apply_ops_batch = jax.vmap(apply_ops_scan)
 
 
+def wave_min_seq(ops) -> jax.Array:
+    """Per-doc zamboni floor for a [D, K, OP_FIELDS] wave: the msn of the
+    LAST real op applied to each doc. msn is monotone per doc and NOOP
+    padding carries msn 0, so this is simply the max over the wave. Using
+    the wave's own msn (not a later one) is what keeps compaction safe
+    while later-sequenced ops are still staged on the host: deli
+    guarantees every future op's refSeq ≥ the msn it stamped HERE, not
+    the msn it stamped afterwards."""
+    return jnp.max(ops[..., F_MSN], axis=-1)
+
+
 def compact(state: DocState, min_seq) -> DocState:
     """Zamboni, device-side: drop slots whose remove seq ≤ minSeq (no future
     perspective can see them; ref mergeTree.ts:1455) and re-pack in order."""
@@ -265,15 +377,23 @@ def compact(state: DocState, min_seq) -> DocState:
     order = jnp.argsort(jnp.where(keep, i, S + i))  # kept first, stable
     new_count = jnp.sum(keep.astype(jnp.int32))
     live = jnp.arange(S, dtype=jnp.int32) < new_count
-    g = lambda a, fill: jnp.where(live, a[order], fill)
+
+    def g(a, fill):
+        gathered = a[order]
+        mask = live if a.ndim == 1 else live[:, None]
+        return jnp.where(mask, gathered, fill)
+
     return DocState(
         length=g(state.length, 0),
         text_start=g(state.text_start, 0),
+        flags=g(state.flags, 0),
         ins_seq=g(state.ins_seq, 0),
         ins_client=g(state.ins_client, NO_CLIENT),
         rem_seq=g(state.rem_seq, NO_SEQ),
         rem_client_a=g(state.rem_client_a, NO_CLIENT),
         rem_client_b=g(state.rem_client_b, NO_CLIENT),
+        prop_key=g(state.prop_key, NO_KEY),
+        prop_val=g(state.prop_val, 0),
         count=new_count,
         overflow=state.overflow,
     )

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.apply import F_TYPE, OP_NOOP, apply_ops_batch, compact_batch
+from ..ops.apply import F_TYPE, OP_NOOP, apply_ops_batch, compact_batch, wave_min_seq
 from ..ops.doc_state import DocState
 
 
@@ -36,14 +36,16 @@ def shard_state(state: DocState, mesh: Mesh) -> DocState:
 def make_sharded_step(mesh: Mesh, donate: bool = True):
     """Build the jitted sharded step:
 
-    ``step(state, ops, min_seq) -> (state', stats)`` where ``state`` holds
-    [D, S] segment arrays sharded over 'docs', ``ops`` is [D, K, OP_FIELDS]
-    int32 (NOOP-padded), and ``stats`` is a replicated dict of globals.
+    ``step(state, ops) -> (state', stats)`` where ``state`` holds [D, S]
+    segment arrays sharded over 'docs', ``ops`` is [D, K, OP_FIELDS] int32
+    (NOOP-padded, each op carrying its deli msn in F_MSN), and ``stats``
+    is a replicated dict of globals. Zamboni compaction runs fused per
+    doc at the wave's own msn floor (apply.wave_min_seq).
     """
 
-    def _local(state: DocState, ops: jax.Array, min_seq: jax.Array):
+    def _local(state: DocState, ops: jax.Array):
         state = apply_ops_batch(state, ops)
-        state = compact_batch(state, jnp.broadcast_to(min_seq, state.count.shape))
+        state = compact_batch(state, wave_min_seq(ops))
         applied = jnp.sum((ops[..., F_TYPE] != OP_NOOP).astype(jnp.int32))
         overflowed = jnp.sum(state.overflow.astype(jnp.int32))
         stats = {
@@ -56,7 +58,7 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
     sharded = jax.shard_map(
         _local,
         mesh=mesh,
-        in_specs=(dp, dp, P()),
+        in_specs=(dp, dp),
         out_specs=(dp, P()),
         check_vma=False,
     )

@@ -15,15 +15,19 @@ Semantics guardrails:
 - Ops ingest ONLY from the sequenced stream, so the server-side invariants
   hold (every stamp below the incoming seq; tie-break = earliest
   boundary — see ops/apply.py docstring).
-- Anything the kernel does not model (annotate ops, slot-capacity or
-  remove-overlap overflow) flips the doc to HOST mode: the scalar oracle
-  (mergetree/) replays the doc's authoritative op log from scriptorium.
-  This is the overflow-to-host escape hatch of SURVEY §7(e).
+- Insert/remove/annotate all stay on the device. Anything the kernel does
+  not model (slot-capacity, remove-overlap, or property-table overflow)
+  flips the doc to HOST mode: the scalar oracle (mergetree/) replays the
+  doc's authoritative op log from scriptorium. This is the
+  overflow-to-host escape hatch of SURVEY §7(e).
+- Every staged op carries the msn deli stamped on its sequenced message,
+  so device zamboni (ops/apply.compact) runs fused after every wave at
+  the exact collaboration-window floor — slot usage stays bounded under
+  churn instead of growing until escalation.
 """
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import replace
 from typing import Optional
 
@@ -34,29 +38,25 @@ import numpy as np
 from ..mergetree.client import MergeTreeClient
 from ..mergetree.ops import AnnotateOp, GroupOp, InsertOp, RemoveOp, op_from_wire
 from ..ops.apply import (
+    NO_VAL,
+    OP_ANNOTATE,
     OP_FIELDS,
     OP_INSERT,
     OP_REMOVE,
     apply_ops_batch,
     compact_batch,
     make_op,
+    wave_min_seq,
 )
-from ..ops.doc_state import DocState, TextArena
+from ..ops.doc_state import FLAG_MARKER, DocState, PropTable, TextArena, decode_state
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from ..parallel.placement import DocPlacement
 
-MARKER_GLYPH = "￼"
+MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 
-
-def _intern_client(client_id: Optional[str]) -> int:
-    """Stable 24-bit client id for stamp comparisons. Deterministic across
-    processes (unlike hash()); a collision would merge two clients'
-    own-op visibility, astronomically unlikely within one doc's lifetime
-    of connected clients."""
-    if client_id is None:
-        return (1 << 24) - 1
-    return int.from_bytes(
-        hashlib.sha1(client_id.encode()).digest()[:3], "little")
+# interned id for server/system-originated stamps (never collides with the
+# dense per-doc table, which grows upward from 0)
+SYSTEM_CLIENT = (1 << 30) - 1
 
 
 def channel_stream(server, tenant_id: str, document_id: str,
@@ -96,6 +96,11 @@ class TpuDocumentApplier:
             jnp.arange(max_docs)
         )
         self.arenas: list[TextArena] = [TextArena() for _ in range(max_docs)]
+        self.prop_table = PropTable()  # shared across docs; ids are dense
+        # per-doc dense client interning — collision-free by construction
+        # (the round-1 truncated-hash scheme could merge two clients'
+        # own-op visibility at the 24-bit birthday bound)
+        self._client_ids: dict[int, dict[str, int]] = {}
         self._staged: dict[int, list[np.ndarray]] = {}
         self._host_docs: dict[int, MergeTreeClient] = {}  # escalated docs
         self._doc_keys: dict[int, tuple[str, str]] = {}
@@ -112,9 +117,9 @@ class TpuDocumentApplier:
         self.host_escalations = 0
 
     @staticmethod
-    def _local_step(state: DocState, ops: jax.Array, min_seq: jax.Array):
+    def _local_step(state: DocState, ops: jax.Array):
         state = apply_ops_batch(state, ops)
-        state = compact_batch(state, jnp.broadcast_to(min_seq, state.count.shape))
+        state = compact_batch(state, wave_min_seq(ops))
         return state, {}
 
     # ------------------------------------------------------------- ingest
@@ -123,6 +128,16 @@ class TpuDocumentApplier:
         shard, slot = self.placement.place(tenant_id, document_id)
         self._doc_keys.setdefault(slot, (tenant_id, document_id))
         return slot
+
+    def _intern_client(self, slot: int, client_id: Optional[str]) -> int:
+        if client_id is None:
+            return SYSTEM_CLIENT
+        table = self._client_ids.setdefault(slot, {})
+        cid = table.get(client_id)
+        if cid is None:
+            cid = len(table)
+            table[client_id] = cid
+        return cid
 
     def ingest(
         self,
@@ -156,18 +171,45 @@ class TpuDocumentApplier:
         common = dict(
             seq=msg.sequence_number,
             ref_seq=msg.reference_sequence_number,
-            client=_intern_client(msg.client_id),
+            client=self._intern_client(slot, msg.client_id),
+            msn=msg.minimum_sequence_number,
         )
         if isinstance(op, InsertOp):
-            text = MARKER_GLYPH if op.marker is not None else (op.text or "")
-            start = self.arenas[slot].append(text)
-            return [make_op(OP_INSERT, pos=op.pos, text_len=len(text),
-                            text_start=start, **common)]
+            if op.marker is not None:
+                start = self.arenas[slot].append(MARKER_GLYPH)
+                tlen = 1
+                vecs = [make_op(OP_INSERT, pos=op.pos, text_len=1,
+                                text_start=start, flags=FLAG_MARKER, **common)]
+            else:
+                text = op.text or ""
+                start = self.arenas[slot].append(text)
+                tlen = len(text)
+                vecs = [make_op(OP_INSERT, pos=op.pos, text_len=tlen,
+                                text_start=start, **common)]
+            # insert-with-props (oracle attaches props to the new segment):
+            # at the insert's OWN perspective the visible span
+            # [pos, pos+len) is exactly the new slot, so follow-up
+            # annotates stamp precisely it
+            vecs.extend(self._annotate_vecs(op.pos, op.pos + tlen,
+                                            op.props or {}, common))
+            return vecs
         if isinstance(op, RemoveOp):
             return [make_op(OP_REMOVE, pos=op.start, end=op.end, **common)]
         if isinstance(op, AnnotateOp):
-            return None  # property ops are host-mode only
+            return self._annotate_vecs(op.start, op.end, op.props, common)
         return None
+
+    def _annotate_vecs(self, start, end, props: dict, common: dict) -> list:
+        # one device op per key; in-order apply gives per-key LWW
+        return [
+            make_op(
+                OP_ANNOTATE, pos=start, end=end,
+                key=self.prop_table.intern_key(k),
+                val=NO_VAL if v is None else self.prop_table.intern_val(v),
+                **common,
+            )
+            for k, v in props.items()
+        ]
 
     # -------------------------------------------------------------- flush
 
@@ -193,8 +235,7 @@ class TpuDocumentApplier:
 
                 ops_dev = jax.device_put(
                     ops_dev, NamedSharding(self._mesh, P("docs")))
-            self.state, _ = self._step(
-                self.state, ops_dev, jnp.asarray(0, jnp.int32))
+            self.state, _ = self._step(self.state, ops_dev)
             self.dispatches += 1
         self.ops_applied += total
         self._check_overflow()
@@ -208,21 +249,67 @@ class TpuDocumentApplier:
 
     # ------------------------------------------------------------- queries
 
+    def slot_count(self, tenant_id: str, document_id: str) -> int:
+        """Live device slots for a doc (bounded under churn by zamboni)."""
+        slot = self.slot_of(tenant_id, document_id)
+        return int(np.asarray(self.state.count)[slot])
+
+    def _device_slot(self, slot: int) -> DocState:
+        return jax.tree.map(lambda a: np.asarray(a)[slot], self.state)
+
     def get_text(self, tenant_id: str, document_id: str) -> str:
         slot = self.slot_of(tenant_id, document_id)
         if self._staged.get(slot):
             self.flush()
         if slot in self._host_docs:
             return self._host_docs[slot].get_text()
-        single = jax.tree.map(lambda a: np.asarray(a)[slot], self.state)
+        single = self._device_slot(slot)
         out, arena = [], self.arenas[slot]
         for i in range(int(single.count)):
             if single.rem_seq[i] != -1:
                 continue
-            text = arena.slice(int(single.text_start[i]), int(single.length[i]))
-            if text != MARKER_GLYPH:
-                out.append(text)
+            if single.flags[i] & FLAG_MARKER:
+                continue  # markers contribute length, not text
+            out.append(arena.slice(int(single.text_start[i]), int(single.length[i])))
         return "".join(out)
+
+    def get_tree(self, tenant_id: str, document_id: str) -> "MergeTreeClient":
+        """Decode the doc to an oracle tree (summaries / inspection)."""
+        slot = self.slot_of(tenant_id, document_id)
+        if self._staged.get(slot):
+            self.flush()
+        if slot in self._host_docs:
+            return self._host_docs[slot]
+        tree = decode_state(self._device_slot(slot), self.arenas[slot],
+                            self.prop_table)
+        replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
+        replica.tree = tree
+        return replica
+
+    def get_properties_at(self, tenant_id: str, document_id: str,
+                          pos: int) -> dict:
+        """Properties of the visible character at ``pos`` (final
+        perspective) — the annotate-path query surface."""
+        slot = self.slot_of(tenant_id, document_id)
+        if self._staged.get(slot):
+            self.flush()
+        if slot in self._host_docs:
+            return self._host_docs[slot].get_properties_at(pos)
+        single = self._device_slot(slot)
+        cum = 0
+        for i in range(int(single.count)):
+            if single.rem_seq[i] != -1:
+                continue
+            if cum <= pos < cum + int(single.length[i]):
+                props = {}
+                for p in range(single.prop_key.shape[-1]):
+                    kid = int(single.prop_key[i, p])
+                    if kid != -1:
+                        props[self.prop_table.key(kid)] = self.prop_table.val(
+                            int(single.prop_val[i, p]))
+                return props
+            cum += int(single.length[i])
+        raise IndexError(pos)
 
     # ---------------------------------------------------- host escalation
 

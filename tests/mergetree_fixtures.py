@@ -122,12 +122,19 @@ def random_op(fc: FarmClient, rng: random.Random, allow_annotate: bool = True) -
     if n == 0 or roll < 0.55:
         pos = rng.randint(0, n)
         text = "".join(rng.choice("abcdefgh") for _ in range(rng.randint(1, 4)))
-        fc.insert(pos, text)
+        props = None
+        if allow_annotate and rng.random() < 0.2:  # insert-with-props
+            props = {"k": rng.randint(0, 3)}
+        fc.insert(pos, text, props)
     elif roll < 0.85 or not allow_annotate:
         start = rng.randint(0, n - 1)
         end = rng.randint(start + 1, min(n, start + 5))
         fc.remove(start, end)
-    else:
+    elif roll < 0.95:
         start = rng.randint(0, n - 1)
         end = rng.randint(start + 1, min(n, start + 6))
         fc.annotate(start, end, {"k": rng.randint(0, 3)})
+    else:  # key deletion
+        start = rng.randint(0, n - 1)
+        end = rng.randint(start + 1, min(n, start + 6))
+        fc.annotate(start, end, {"k": None})
