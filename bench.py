@@ -1,10 +1,22 @@
-"""Headline benchmark: batched merge-tree sequenced-op apply throughput.
+"""Headline benchmark: the service path end-to-end, plus the raw kernel.
 
-Measures merge-tree ops/sec across a batch of concurrent documents on one
-chip — the TPU analog of BASELINE.md config 4 (N SharedString docs of
-concurrent edits). Prints ONE JSON line; vs_baseline is against the
-north-star target of 50,000 ops/sec (BASELINE.json — the reference repo
-publishes no numbers, so the north star is the bar).
+Three measurements in ONE JSON line (round-1 VERDICT #2: an end-to-end
+number, not a dispatch microbenchmark):
+
+- ``value`` (headline): sequenced ops/sec through the FULL in-process
+  service path — deli ticketing, scriptorium persistence, scribe protocol
+  replica, broadcast fan-out to every connected client, AND the
+  TpuDocumentApplier device batch riding the stream (BASELINE config 4
+  analog; north star 50k ops/s).
+- ``kernel_ops_per_sec``: the batched device kernel alone at scale
+  (10k-doc scribe-replay role, BASELINE config 5), timed against a real
+  host readback — NOT block_until_ready, which the axon tunnel treats as
+  a no-op and which inflated the round-1 number.
+- ``net_p99_ack_ms`` / ``net_p50_ack_ms``: op-ack latency through real
+  TCP sockets (submit → own op broadcast back), north star p99 < 50 ms.
+
+vs_baseline is the headline value against the 50k north star
+(BASELINE.json — the reference repo publishes no numbers of its own).
 """
 
 from __future__ import annotations
@@ -12,58 +24,113 @@ from __future__ import annotations
 import json
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 NORTH_STAR_OPS_PER_SEC = 50_000.0
 
 
-def main() -> None:
-    from fluidframework_tpu.ops.apply import apply_ops_batch, compact_batch
+def bench_kernel() -> float:
+    """Batched device apply+zamboni at 8k docs, honest readback timing."""
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.apply import (
+        apply_ops_batch,
+        compact_batch,
+        wave_min_seq,
+    )
     from fluidframework_tpu.ops.doc_state import DocState
     from fluidframework_tpu.ops.opgen import generate_batch_ops
 
-    D, S, K, NB = 512, 512, 32, 4  # docs × slots × ops/dispatch × dispatches
+    D, S, K, NB = 8192, 256, 32, 2
     rng = np.random.default_rng(42)
-
-    from fluidframework_tpu.ops.apply import wave_min_seq
 
     @jax.jit
     def step(state, ops):
         state = apply_ops_batch(state, ops)
         return compact_batch(state, wave_min_seq(ops))
 
-    state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
-    # one continuous valid stream of K*NB ops per doc, split into NB dispatches
+    state0 = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
     stream = generate_batch_ops(
         rng, D, K * NB, remove_fraction=0.4, annotate_fraction=0.1, max_insert=8)
     batches = [jnp.asarray(stream[:, i * K : (i + 1) * K]) for i in range(NB)]
 
-    # compile + warm up
-    state = jax.block_until_ready(step(state, batches[0]))
+    # compile + warm up, with a real transfer as the sync point
+    s = step(state0, batches[0])
+    assert int(np.asarray(s.count).min()) > 0
 
-    n_rounds = 8
-    fresh = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
-    finals = []  # keep every round's end state so no dispatch escapes the wait
     t0 = time.perf_counter()
-    for _ in range(n_rounds):
-        cur = fresh  # streams are generated against an empty doc
-        for ops in batches:
-            cur = step(cur, ops)
-        finals.append(cur.count)
-    jax.block_until_ready(finals)
+    cur = state0
+    for ops in batches:
+        cur = step(cur, ops)
+    counts = np.asarray(cur.count)  # host readback = the only honest fence
     dt = time.perf_counter() - t0
+    assert counts.min() > 0, "streams failed to apply"
+    return D * K * NB / dt
 
-    assert not bool(jnp.any(finals[-1] == 0)), "streams failed to apply"
-    ops_per_sec = D * K * NB * n_rounds / dt
+
+def bench_service() -> dict:
+    """Full in-process pipeline with the TPU applier riding the stream."""
+    from fluidframework_tpu.service.load_gen import run_inproc
+    from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
+
+    # compile warm-up on a THROWAWAY applier: reusing it would leave
+    # warm-up doc state in the placement slots the measured docs hash to
+    # (same names, fresh server, seqs restarting at 1)
+    warm = TpuDocumentApplier(max_docs=128, max_slots=256, ops_per_dispatch=32)
+    run_inproc(n_docs=8, clients_per_doc=2, ops_per_client=5,
+               applier=warm, seed=99)
+    applier = TpuDocumentApplier(max_docs=128, max_slots=256,
+                                 ops_per_dispatch=32)
+    stats = run_inproc(n_docs=64, clients_per_doc=2, ops_per_client=40,
+                       applier=applier, flush_every=2048, seed=1)
+    assert stats.applier_escalations == 0
+    assert stats.ops_acked == stats.ops_submitted
+    return stats.summary()
+
+
+def bench_network() -> dict:
+    """Socket clients against a live front end: real op-ack latency."""
+    from fluidframework_tpu.service import NetworkFrontEnd
+    from fluidframework_tpu.service.load_gen import run_network
+
+    fe = NetworkFrontEnd().start_background()
+    try:
+        # warm-up: orderer creation, joins, first broadcasts (discarded)
+        run_network(fe.port, n_docs=2, clients_per_doc=2,
+                    ops_per_client=30, seed=7)
+        # median of 3 trials by p99: the shared bench host has bursty
+        # CPU contention that can inflate a single trial by 10-50x
+        trials = []
+        for t in range(3):
+            stats = run_network(fe.port, n_docs=2, clients_per_doc=2,
+                                ops_per_client=300, rate_hz=1000.0,
+                                seed=10 + t)
+            assert stats.ops_acked == stats.ops_submitted
+            trials.append(stats.summary())
+        trials.sort(key=lambda s: s["p99_ack_ms"])
+        return trials[1]
+    finally:
+        fe.stop()
+
+
+def main() -> None:
+    # network first: the latency measurement must not share the process
+    # with a TPU tunnel already saturated by the kernel/service benches
+    net = bench_network()
+    kernel_ops = bench_kernel()
+    service = bench_service()
     print(
         json.dumps(
             {
-                "metric": "merge_tree_ops_per_sec",
-                "value": round(ops_per_sec, 1),
+                "metric": "service_path_ops_per_sec",
+                "value": service["ops_per_sec"],
                 "unit": "ops/s",
-                "vs_baseline": round(ops_per_sec / NORTH_STAR_OPS_PER_SEC, 3),
+                "vs_baseline": round(service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
+                "kernel_ops_per_sec": round(kernel_ops, 1),
+                "net_ops_per_sec": net["ops_per_sec"],
+                "net_p50_ack_ms": net["p50_ack_ms"],
+                "net_p99_ack_ms": net["p99_ack_ms"],
             }
         )
     )

@@ -20,6 +20,7 @@ from .definitions import (
     DocumentStorage,
 )
 from .local import LocalDocumentServiceFactory
+from .network import NetworkDocumentServiceFactory
 
 __all__ = [
     "DocumentDeltaConnection",
@@ -28,4 +29,5 @@ __all__ = [
     "DocumentServiceFactory",
     "DocumentStorage",
     "LocalDocumentServiceFactory",
+    "NetworkDocumentServiceFactory",
 ]

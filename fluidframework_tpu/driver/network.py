@@ -1,0 +1,277 @@
+"""Network driver: client stack ⇄ NetworkFrontEnd over TCP.
+
+Ref: packages/drivers/routerlicious-driver (documentService.ts:22 wires
+stream + delta storage + snapshot storage to the service endpoints) and
+driver-base/src/documentDeltaConnection.ts:53 (the socket client emitting
+connect_document/submitOp and listening op/nack/signal). Same wire format
+as service/front_end.py: 4-byte length-prefixed JSON frames.
+
+Concurrency: a daemon reader thread dispatches pushed events (op, nack,
+signal) into the client callbacks under ``self.lock``; submits take the
+same lock, so the client replica never interleaves a local submit with a
+remote dispatch. Request/response calls (deltas, storage) ride the same
+connection, matched by request id.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from ..protocol.serialization import message_from_dict, message_to_dict
+from .definitions import (
+    DocumentDeltaConnection,
+    DocumentDeltaStorage,
+    DocumentService,
+    DocumentServiceFactory,
+    DocumentStorage,
+)
+
+
+class _Transport:
+    """One framed TCP connection + reader thread + rid-matched requests."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.timeout = timeout
+        self.lock = threading.RLock()  # serializes dispatch vs. submit
+        self._wlock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: dict[int, dict] = {}  # rid → reply frame
+        self._pending_cv = threading.Condition()
+        self._push_handlers: dict[str, Callable[[dict], None]] = {}
+        self.on_disconnect: Optional[Callable[[str], None]] = None
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="fluid-net-reader")
+        self._reader.start()
+
+    # ------------------------------------------------------------- sending
+
+    def send(self, frame: dict) -> None:
+        body = json.dumps(frame, separators=(",", ":")).encode()
+        with self._wlock:
+            self.sock.sendall(len(body).to_bytes(4, "big") + body)
+
+    def request(self, frame: dict) -> dict:
+        """Send a frame with a request id; block for the matching reply."""
+        rid = next(self._rid)
+        self.send(dict(frame, rid=rid))
+        with self._pending_cv:
+            ok = self._pending_cv.wait_for(
+                lambda: rid in self._pending or self._closed,
+                timeout=self.timeout)
+            if not ok or rid not in self._pending:
+                raise ConnectionError(
+                    f"no reply for {frame.get('t')} (connection "
+                    f"{'closed' if self._closed else 'timed out'})")
+            reply = self._pending.pop(rid)
+        if reply.get("t") == "error":
+            raise RuntimeError(f"server error: {reply.get('message')}")
+        return reply
+
+    # ------------------------------------------------------------ receiving
+
+    def _recv_exactly(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except (OSError, ValueError):
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        reason = "connection closed by server"
+        try:
+            while not self._closed:
+                header = self._recv_exactly(4)
+                if header is None:
+                    break
+                body = self._recv_exactly(int.from_bytes(header, "big"))
+                if body is None:
+                    break
+                frame = json.loads(body.decode())
+                rid = frame.get("rid")
+                if rid is not None:
+                    with self._pending_cv:
+                        self._pending[rid] = frame
+                        self._pending_cv.notify_all()
+                else:
+                    handler = self._push_handlers.get(frame.get("t"))
+                    if handler is not None:
+                        with self.lock:
+                            handler(frame)
+        except Exception as e:  # a raising push handler must not leave
+            reason = f"reader failed: {e}"  # requesters hanging silently
+        finally:
+            # wake any blocked requester, then notify disconnect
+            with self._pending_cv:
+                self._closed = True
+                self._pending_cv.notify_all()
+            if self.on_disconnect is not None:
+                self.on_disconnect(reason)
+
+    def on_push(self, t: str, handler: Callable[[dict], None]) -> None:
+        self._push_handlers[t] = handler
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class NetworkDeltaConnection(DocumentDeltaConnection):
+    """The live stream over the shared transport. Events arriving before a
+    callback is attached are buffered and flushed on attach (same contract
+    as the in-proc ServerConnection)."""
+
+    def __init__(self, transport: _Transport, tenant_id: str,
+                 document_id: str, details: Any = None):
+        self._t = transport
+        self.lock = transport.lock
+        self._handlers: dict[str, Optional[Callable]] = {
+            "op": None, "nack": None, "signal": None}
+        self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
+        self.on_disconnect = None
+        transport.on_push("op", lambda f: self._deliver(
+            "op", message_from_dict(f["msg"])))
+        transport.on_push("nack", lambda f: self._deliver(
+            "nack", message_from_dict(f["nack"])))
+        transport.on_push("signal", lambda f: self._deliver(
+            "signal", message_from_dict(f["signal"])))
+        transport.on_disconnect = lambda reason: (
+            self.on_disconnect(reason) if self.on_disconnect else None)
+        reply = transport.request({
+            "t": "connect", "tenant": tenant_id, "doc": document_id,
+            "details": details})
+        self.client_id = reply["clientId"]
+        self.initial_sequence_number = reply["seq"]
+        self.max_message_size = reply.get("maxMessageSize")
+
+    def _deliver(self, kind: str, event) -> None:
+        cb = self._handlers[kind]
+        if cb is None:
+            self._buffers[kind].append(event)
+        else:
+            cb(event)
+
+    def _set_handler(self, kind: str, cb) -> None:
+        with self._t.lock:
+            self._handlers[kind] = cb
+            if cb is not None:
+                pending, self._buffers[kind] = self._buffers[kind], []
+                for event in pending:
+                    cb(event)
+
+    on_op = property(lambda self: self._handlers["op"],
+                     lambda self, cb: self._set_handler("op", cb))
+    on_nack = property(lambda self: self._handlers["nack"],
+                       lambda self, cb: self._set_handler("nack", cb))
+    on_signal = property(lambda self: self._handlers["signal"],
+                         lambda self, cb: self._set_handler("signal", cb))
+
+    def submit(self, messages) -> None:
+        with self._t.lock:
+            self._t.send({"t": "submit",
+                          "ops": [message_to_dict(m) for m in messages]})
+
+    def submit_signal(self, content: Any, type: str = "signal") -> None:
+        self._t.send({"t": "signal", "content": content, "type": type})
+
+    def close(self) -> None:
+        try:
+            self._t.send({"t": "disconnect"})
+        except OSError:
+            pass
+        self._t.close()
+        if self.on_disconnect:
+            self.on_disconnect("client closed connection")
+
+
+class NetworkDeltaStorage(DocumentDeltaStorage):
+    def __init__(self, transport: _Transport, tenant_id: str, document_id: str):
+        self._t = transport
+        self._tenant = tenant_id
+        self._doc = document_id
+
+    def get_deltas(self, from_seq: int, to_seq: int):
+        reply = self._t.request({
+            "t": "get_deltas", "tenant": self._tenant, "doc": self._doc,
+            "from": from_seq, "to": to_seq})
+        return [message_from_dict(d) for d in reply["msgs"]]
+
+
+class NetworkStorage(DocumentStorage):
+    def __init__(self, transport: _Transport, tenant_id: str, document_id: str):
+        self._t = transport
+        self._tenant = tenant_id
+        self._doc = document_id
+
+    def _req(self, t: str, **kw) -> dict:
+        return self._t.request(
+            {"t": t, "tenant": self._tenant, "doc": self._doc, **kw})
+
+    def get_versions(self, count: int = 1) -> list[dict]:
+        return self._req("get_versions", count=count)["versions"]
+
+    def get_snapshot_tree(self, version: Optional[dict] = None):
+        return self._req("get_tree", version=version)["tree"]
+
+    def read_blob(self, blob_id: str) -> bytes:
+        return bytes.fromhex(self._req("read_blob", id=blob_id)["hex"])
+
+    def write_blob(self, content: bytes) -> str:
+        return self._req("write_blob", hex=content.hex())["id"]
+
+    def upload_summary(self, summary: Any, parent: Optional[str]) -> str:
+        return self._req("upload_summary", summary=summary, parent=parent)["id"]
+
+
+class NetworkDocumentService(DocumentService):
+    """One document's bindings over the network. The delta stream gets its
+    own TCP connection (it carries the push traffic); delta/snapshot
+    storage share a second, request-only connection — mirroring the
+    reference's socket + REST split."""
+
+    def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
+                 timeout: float = 30.0):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._tenant = tenant_id
+        self._doc = document_id
+        self._rpc: Optional[_Transport] = None
+
+    def _rpc_transport(self) -> _Transport:
+        if self._rpc is None or self._rpc._closed:
+            self._rpc = _Transport(self._host, self._port, self._timeout)
+        return self._rpc
+
+    def connect_to_delta_stream(self, details: Any = None) -> NetworkDeltaConnection:
+        t = _Transport(self._host, self._port, self._timeout)
+        return NetworkDeltaConnection(t, self._tenant, self._doc, details)
+
+    def connect_to_delta_storage(self) -> NetworkDeltaStorage:
+        return NetworkDeltaStorage(self._rpc_transport(), self._tenant, self._doc)
+
+    def connect_to_storage(self) -> NetworkStorage:
+        return NetworkStorage(self._rpc_transport(), self._tenant, self._doc)
+
+
+class NetworkDocumentServiceFactory(DocumentServiceFactory):
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._host, self._port, self._timeout = host, port, timeout
+
+    def create_document_service(
+        self, tenant_id: str, document_id: str
+    ) -> NetworkDocumentService:
+        return NetworkDocumentService(
+            self._host, self._port, tenant_id, document_id, self._timeout)

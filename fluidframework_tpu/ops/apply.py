@@ -120,73 +120,28 @@ _SLOT_FIELDS = (
 )
 
 
-def _gather(state: DocState, src, **overrides) -> dict:
-    """Gather every per-slot field along the slot axis (2-D prop tables
-    gather whole rows)."""
-    fields = {}
-    for name in _SLOT_FIELDS:
-        fields[name] = getattr(state, name)[src]
-    fields.update(overrides)
-    return fields
+def _shift1(a):
+    """out[i] = a[i-1] (out[0] is never selected by callers)."""
+    return jnp.roll(a, 1, axis=0)
 
 
-def _apply_insert(state: DocState, op) -> DocState:
-    S = state.max_slots
-    pos, seq, ref_seq = op[F_POS], op[F_SEQ], op[F_REFSEQ]
-    client, tlen, tstart = op[F_CLIENT], op[F_TLEN], op[F_TSTART]
-    vis, vlen, cum = _visibility(state, ref_seq, client)
-    total = jnp.sum(vlen)
-    inc = cum + vlen
-
-    inside = vis & (cum < pos) & (pos < inc)
-    split = jnp.any(inside)
-    j = jnp.argmax(inside)  # containing slot when split
-    o = pos - cum[j]  # split offset
-    # earliest boundary: first slot whose exclusive prefix reaches pos —
-    # lands BEFORE any run of zero-visible slots (tombstones / concurrent
-    # inserts), matching MergeTree.resolve
-    b = jnp.argmax(cum >= pos)
-    idx = jnp.where(split, j + 1, b)
-
-    i = jnp.arange(S, dtype=jnp.int32)
-    src_boundary = i - (i > idx)
-    src_split = jnp.where(i <= j, i, jnp.where(i <= idx + 1, j, i - 2))
-    src = jnp.clip(jnp.where(split, src_split, src_boundary), 0, S - 1)
-
-    f = _gather(state, src)
-    head = split & (i == j)
-    tail = split & (i == idx + 1)
-    new = i == idx
-    new2 = new[:, None]  # broadcast over the prop-table axis
-    length = jnp.where(head, o, f["length"])
-    length = jnp.where(tail, state.length[j] - o, length)
-    length = jnp.where(new, jnp.where(tlen > 0, tlen, 1), length)
-    text_start = jnp.where(tail, state.text_start[j] + o, f["text_start"])
-    text_start = jnp.where(new, tstart, text_start)
-
-    new_count = state.count + 1 + split.astype(jnp.int32)
-    bad = (pos > total) | (new_count > S)
-    out = DocState(
-        length=length,
-        text_start=text_start,
-        flags=jnp.where(new, op[F_FLAGS], f["flags"]),
-        ins_seq=jnp.where(new, seq, f["ins_seq"]),
-        ins_client=jnp.where(new, client, f["ins_client"]),
-        rem_seq=jnp.where(new, NO_SEQ, f["rem_seq"]),
-        rem_client_a=jnp.where(new, NO_CLIENT, f["rem_client_a"]),
-        rem_client_b=jnp.where(new, NO_CLIENT, f["rem_client_b"]),
-        prop_key=jnp.where(new2, NO_KEY, f["prop_key"]),
-        prop_val=jnp.where(new2, 0, f["prop_val"]),
-        count=new_count,
-        overflow=state.overflow | bad,
+def _fieldwise(state: DocState, fn, count, overflow) -> DocState:
+    return DocState(
+        **{name: fn(name, getattr(state, name)) for name in _SLOT_FIELDS},
+        count=count,
+        overflow=overflow,
     )
-    return _select_state(bad, state, out)
 
 
 def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
     """Split the segment strictly containing visible position ``pos``
     (no-op when pos falls on a boundary). Both halves keep identical
-    stamps, flags, and properties (ref: BaseSegment.splitAt)."""
+    stamps, flags, and properties (ref: BaseSegment.splitAt).
+
+    Gather-free: the rebuild is a static roll-by-one plus selects (TPU
+    gathers with computed indices are the slow path; rolls and selects
+    vectorize onto the VPU).
+    """
     S = state.max_slots
     vis, vlen, cum = _visibility(state, ref_seq, client)
     inside = vis & (cum < pos) & (pos < cum + vlen)
@@ -195,51 +150,151 @@ def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
     o = pos - cum[j]
 
     i = jnp.arange(S, dtype=jnp.int32)
-    src = jnp.clip(jnp.where(i <= j, i, jnp.where(i == j + 1, j, i - 1)), 0, S - 1)
-    f = _gather(state, src)
-    head = i == j
-    tail = i == (j + 1)
-    length = jnp.where(head, o, f["length"])
-    length = jnp.where(tail, state.length[j] - o, length)
-    text_start = jnp.where(tail, state.text_start[j] + o, f["text_start"])
-    out = DocState(
-        length=length,
-        text_start=text_start,
-        flags=f["flags"],
-        ins_seq=f["ins_seq"],
-        ins_client=f["ins_client"],
-        rem_seq=f["rem_seq"],
-        rem_client_a=f["rem_client_a"],
-        rem_client_b=f["rem_client_b"],
-        prop_key=f["prop_key"],
-        prop_val=f["prop_val"],
-        count=state.count + 1,
+    keep = ~has | (i <= j)  # slots at/before the split point stay put
+    is_tail = has & (i == j + 1)
+
+    def rebuild(name, a):
+        aj = a[j]  # scalar (or [P] row) dynamic read — cheap
+        if a.ndim == 2:
+            return jnp.where(keep[:, None], a,
+                             jnp.where(is_tail[:, None], aj[None, :],
+                                       _shift1(a)))
+        out = jnp.where(keep, a, jnp.where(is_tail, aj, _shift1(a)))
+        if name == "length":
+            out = jnp.where(has & (i == j), o, out)
+            out = jnp.where(is_tail, state.length[j] - o, out)
+        elif name == "text_start":
+            out = jnp.where(is_tail, state.text_start[j] + o, out)
+        return out
+
+    return _fieldwise(
+        state,
+        rebuild,
+        count=state.count + has.astype(jnp.int32),
         overflow=state.overflow | (has & (state.count + 1 > S)),
     )
-    return _select_state(~has, state, out)
 
 
-def _apply_remove(state: DocState, op) -> DocState:
-    start, end = op[F_POS], op[F_END]
+def _apply_unified(state: DocState, op) -> DocState:
+    """One shared path for insert/remove/annotate (noop passes through):
+
+    1. split at pos/start, split at end (no-ops on boundaries — for an
+       insert both land on the same boundary, so neither splits twice);
+    2. insert: shift-open a slot at the earliest boundary reaching pos
+       (lands BEFORE tombstone runs, matching MergeTree.resolve) and
+       stamp it;
+    3. remove: mask-stamp covered slots (overlap keeps earliest stamp,
+       this client records as additional remover);
+    4. annotate: LWW per-key write into the covered slots' prop tables.
+
+    A single structure (vs. a lax.switch of four bodies) matters under
+    vmap: batched switch lowers to executing every branch and selecting,
+    so shared work would otherwise be paid four times.
+    """
+    S = state.max_slots
+    typ = op[F_TYPE]
+    is_ins = typ == OP_INSERT
+    is_rem = typ == OP_REMOVE
+    is_ann = typ == OP_ANNOTATE
+    active = is_ins | is_rem | is_ann
+    pos, end = op[F_POS], op[F_END]
     seq, ref_seq, client = op[F_SEQ], op[F_REFSEQ], op[F_CLIENT]
+    p2 = jnp.where(is_ins, pos, end)
 
-    _, vlen0, _ = _visibility(state, ref_seq, client)
-    bad = (end > jnp.sum(vlen0)) | (end <= start) | (state.count + 2 > state.max_slots)
+    vis0, vlen0, cum0 = _visibility(state, ref_seq, client)
+    total = jnp.sum(vlen0)
+    bad_shape = jnp.where(is_ins, pos > total, (end > total) | (end <= pos))
+    # exact slot demand: a split only happens when the position falls
+    # STRICTLY inside a visible segment (adding the start boundary cannot
+    # move the end strictly inside/outside a segment, so the pre-split
+    # test is exact for both)
+    inc0 = cum0 + vlen0
 
-    st = _split_at(state, start, ref_seq, client)
-    st = _split_at(st, end, ref_seq, client)
+    def strictly_inside(p):
+        return jnp.any(vis0 & (cum0 < p) & (p < inc0)).astype(jnp.int32)
+
+    needed = jnp.where(
+        is_ins,
+        1 + strictly_inside(pos),
+        strictly_inside(pos) + strictly_inside(end),
+    )
+    bad = active & (bad_shape | (state.count + needed > S))
+    # a bad/inactive op must not split: clamp positions to 0 (never
+    # strictly inside a segment) so both splits no-op
+    p1s = jnp.where(active & ~bad, pos, 0)
+    p2s = jnp.where(active & ~bad, p2, 0)
+
+    st = _split_at(state, p1s, ref_seq, client)
+    st = _split_at(st, p2s, ref_seq, client)
 
     vis, vlen, cum = _visibility(st, ref_seq, client)
-    mask = vis & (cum >= start) & (cum + vlen <= end)
-    fresh = mask & (st.rem_seq == NO_SEQ)
-    # overlap: ops apply in seq order so the existing stamp is the earliest;
-    # just record this client as an additional remover
-    over = mask & (st.rem_seq != NO_SEQ)
+    i = jnp.arange(S, dtype=jnp.int32)
+
+    # ---- insert: open a slot at idx and stamp it
+    do_ins = is_ins & ~bad
+    idx = jnp.argmax(cum >= pos)  # earliest boundary (post-split)
+    tlen, tstart = op[F_TLEN], op[F_TSTART]
+    shift = do_ins & (i > idx)
+    new = do_ins & (i == idx)
+
+    new_vals = {
+        "length": jnp.where(tlen > 0, tlen, 1),
+        "text_start": tstart,
+        "flags": op[F_FLAGS],
+        "ins_seq": seq,
+        "ins_client": client,
+        "rem_seq": NO_SEQ,
+        "rem_client_a": NO_CLIENT,
+        "rem_client_b": NO_CLIENT,
+    }
+
+    def insert_shift(name, a):
+        if a.ndim == 2:  # prop tables: new slot starts empty
+            fill = NO_KEY if name == "prop_key" else 0
+            out = jnp.where(shift[:, None], _shift1(a), a)
+            return jnp.where(new[:, None], fill, out)
+        out = jnp.where(shift, _shift1(a), a)
+        return jnp.where(new, new_vals[name], out)
+
+    st = _fieldwise(
+        st,
+        insert_shift,
+        count=st.count + do_ins.astype(jnp.int32),
+        overflow=st.overflow,
+    )
+
+    # ---- remove/annotate target mask. The post-split (pre-insert)
+    # prefix is correct here: the insert shift only runs when do_ins,
+    # in which case this mask is dead — no recompute needed
+    covered = vis & (cum >= pos) & (cum + vlen <= end)
+    rm = is_rem & ~bad & covered
+    fresh = rm & (st.rem_seq == NO_SEQ)
+    # overlap: ops apply in seq order so the existing stamp is the
+    # earliest; just record this client as an additional remover
+    over = rm & (st.rem_seq != NO_SEQ)
     add_b = over & (st.rem_client_a != client) & (st.rem_client_b == NO_CLIENT)
     third = over & (st.rem_client_a != client) & (st.rem_client_b != client) & (
         st.rem_client_b != NO_CLIENT
     )
-    out = DocState(
+
+    # ---- annotate: per-key LWW write (val == NO_VAL deletes the key)
+    key, val = op[F_KEY], op[F_VAL]
+    P = state.max_props
+    an = is_ann & ~bad & covered
+    match = st.prop_key == key  # [S, P]
+    has_key = jnp.any(match, axis=-1)
+    empty = st.prop_key == NO_KEY
+    has_empty = jnp.any(empty, axis=-1)
+    tgt = jnp.where(has_key, jnp.argmax(match, axis=-1), jnp.argmax(empty, axis=-1))
+    is_delete = val == NO_VAL
+    do_write = an & (has_key | (~is_delete & has_empty))
+    onehot = (jnp.arange(P, dtype=jnp.int32)[None, :] == tgt[:, None]) & do_write[
+        :, None
+    ]
+    # a slot that needs a (P+1)th distinct key cannot hold it → escalate
+    table_full = jnp.any(an & ~has_key & ~has_empty & ~is_delete)
+
+    return DocState(
         length=st.length,
         text_start=st.text_start,
         flags=st.flags,
@@ -248,93 +303,16 @@ def _apply_remove(state: DocState, op) -> DocState:
         rem_seq=jnp.where(fresh, seq, st.rem_seq),
         rem_client_a=jnp.where(fresh, client, st.rem_client_a),
         rem_client_b=jnp.where(add_b, client, st.rem_client_b),
-        prop_key=st.prop_key,
-        prop_val=st.prop_val,
+        prop_key=jnp.where(onehot, jnp.where(is_delete, NO_KEY, key), st.prop_key),
+        prop_val=jnp.where(onehot, jnp.where(is_delete, 0, val), st.prop_val),
         count=st.count,
-        overflow=st.overflow | jnp.any(third) | bad,
-    )
-    return _select_state(bad, state, out)
-
-
-def _apply_annotate(state: DocState, op) -> DocState:
-    """Set ONE property (key, value) on visible span [start, end) — the
-    tensorized annotateRange (mergeTree.ts:2598). Multi-key annotates are
-    staged as one op per key. ``val == NO_VAL`` deletes the key (frees its
-    table slot). In-order apply makes per-key LWW automatic."""
-    start, end = op[F_POS], op[F_END]
-    ref_seq, client = op[F_REFSEQ], op[F_CLIENT]
-    key, val = op[F_KEY], op[F_VAL]
-    P = state.max_props
-
-    _, vlen0, _ = _visibility(state, ref_seq, client)
-    bad = (end > jnp.sum(vlen0)) | (end <= start) | (state.count + 2 > state.max_slots)
-
-    st = _split_at(state, start, ref_seq, client)
-    st = _split_at(st, end, ref_seq, client)
-
-    vis, vlen, cum = _visibility(st, ref_seq, client)
-    covered = vis & (cum >= start) & (cum + vlen <= end)
-
-    match = st.prop_key == key  # [S, P]
-    has_key = jnp.any(match, axis=-1)
-    empty = st.prop_key == NO_KEY
-    has_empty = jnp.any(empty, axis=-1)
-    tgt = jnp.where(has_key, jnp.argmax(match, axis=-1), jnp.argmax(empty, axis=-1))
-
-    is_delete = val == NO_VAL
-    do_write = covered & (has_key | (~is_delete & has_empty))
-    onehot = (jnp.arange(P, dtype=jnp.int32)[None, :] == tgt[:, None]) & do_write[
-        :, None
-    ]
-    prop_key = jnp.where(onehot, jnp.where(is_delete, NO_KEY, key), st.prop_key)
-    prop_val = jnp.where(onehot, jnp.where(is_delete, 0, val), st.prop_val)
-    # a slot that needs a (P+1)th distinct key cannot hold it → escalate
-    table_full = jnp.any(covered & ~has_key & ~has_empty & ~is_delete)
-
-    out = DocState(
-        length=st.length,
-        text_start=st.text_start,
-        flags=st.flags,
-        ins_seq=st.ins_seq,
-        ins_client=st.ins_client,
-        rem_seq=st.rem_seq,
-        rem_client_a=st.rem_client_a,
-        rem_client_b=st.rem_client_b,
-        prop_key=prop_key,
-        prop_val=prop_val,
-        count=st.count,
-        overflow=st.overflow | table_full | bad,
-    )
-    return _select_state(bad, state, out)
-
-
-def _select_state(pred, a: DocState, b: DocState) -> DocState:
-    """pred ? a : b, fieldwise (keeping overflow flags from b)."""
-    take = lambda x, y: jnp.where(pred, x, y)
-    return DocState(
-        length=take(a.length, b.length),
-        text_start=take(a.text_start, b.text_start),
-        flags=take(a.flags, b.flags),
-        ins_seq=take(a.ins_seq, b.ins_seq),
-        ins_client=take(a.ins_client, b.ins_client),
-        rem_seq=take(a.rem_seq, b.rem_seq),
-        rem_client_a=take(a.rem_client_a, b.rem_client_a),
-        rem_client_b=take(a.rem_client_b, b.rem_client_b),
-        prop_key=take(a.prop_key, b.prop_key),
-        prop_val=take(a.prop_val, b.prop_val),
-        count=take(a.count, b.count),
-        overflow=b.overflow,  # sticky: set by whichever path ran
+        overflow=st.overflow | jnp.any(third) | table_full | bad,
     )
 
 
 def apply_op(state: DocState, op) -> DocState:
     """Apply one sequenced op vector (int32[OP_FIELDS]) to one doc."""
-    return lax.switch(
-        jnp.clip(op[F_TYPE], 0, 3),
-        [lambda s, o: s, _apply_insert, _apply_remove, _apply_annotate],
-        state,
-        op,
-    )
+    return _apply_unified(state, op)
 
 
 # [D docs] × one op each

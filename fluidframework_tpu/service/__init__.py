@@ -36,3 +36,7 @@ __all__ = [
     "LocalServer",
     "ServerConnection",
 ]
+
+from .front_end import NetworkFrontEnd  # noqa: E402
+
+__all__.append("NetworkFrontEnd")

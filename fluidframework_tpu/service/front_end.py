@@ -1,0 +1,303 @@
+"""NetworkFrontEnd: the socket front door (the Alfred analog).
+
+Ref: lambdas/src/alfred/index.ts:112-405 — the reference's front end is a
+socket.io server doing the connect_document handshake (:159,:285),
+submitOp ordering (:310), signal relay (:405), plus REST routes for delta
+backfill and snapshot storage. Here it is one asyncio TCP server speaking
+length-prefixed JSON frames, serving BOTH the live bidi op stream and the
+request/response (REST-role) endpoints over the same wire format.
+
+Frame = 4-byte big-endian length + JSON body {"t": <type>, ...}:
+
+  client → server
+    connect        {tenant, doc, details, rid}        → connected {clientId, seq, rid}
+    submit         {ops: [DocumentMessage…]}          (fire-and-forget, like socket submitOp)
+    signal         {content, type}
+    get_deltas     {tenant, doc, from, to, rid}       → deltas {msgs, rid}
+    get_versions   {tenant, doc, count, rid}          → versions {versions, rid}
+    get_tree       {tenant, doc, version, rid}        → tree {tree, rid}
+    read_blob      {tenant, doc, id, rid}             → blob {hex, rid}
+    write_blob     {tenant, doc, hex, rid}            → blob_id {id, rid}
+    upload_summary {tenant, doc, summary, parent, rid} → version_id {id, rid}
+    disconnect     {}
+  server → client (push, after connect)
+    op {msg} | nack {nack} | signal {signal}
+  server → client (error reply)
+    error {message, rid?}
+
+Concurrency model: the ENTIRE service (LocalServer pipeline included) runs
+on the event-loop thread, so no server-side locking is needed — the same
+single-writer discipline the reference gets from Node's event loop.
+
+Service limits: submits above ``max_message_size`` (16 KB default, ref
+localDeltaConnectionServer.ts:96) are nacked with BAD_REQUEST without
+entering the pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import threading
+from typing import Any, Optional
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    Nack,
+    NackErrorType,
+)
+from ..protocol.serialization import message_from_dict, message_to_dict
+from .local_server import LocalServer, ServerConnection
+
+MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
+DEFAULT_MAX_MESSAGE_SIZE = 16 * 1024  # per-op cap, nacked (ref :96)
+
+
+def _encode_frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    n = int.from_bytes(header, "big")
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
+    try:
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return json.loads(body.decode())
+
+
+class _ClientSession:
+    """Server-side state for one TCP connection."""
+
+    def __init__(self, front: "NetworkFrontEnd",
+                 writer: asyncio.StreamWriter):
+        self.front = front
+        self.writer = writer
+        self.conn: Optional[ServerConnection] = None
+
+    # -- push events (called synchronously from the pipeline drain, which
+    # runs on the loop thread) --
+    # a session whose unread outbound buffer passes this bound is dropped
+    # (slow-consumer protection — fan-out writes are not awaited, so an
+    # unread socket would otherwise buffer the doc's whole stream in RAM)
+    MAX_BUFFERED = 32 * 1024 * 1024
+
+    def push(self, t: str, payload: dict) -> None:
+        try:
+            if self.writer.is_closing():
+                return
+            transport = self.writer.transport
+            if transport.get_write_buffer_size() > self.MAX_BUFFERED:
+                self.closed()
+                self.writer.close()
+                return
+            self.writer.write(_encode_frame({"t": t, **payload}))
+        except RuntimeError:
+            pass  # transport torn down mid-shutdown; peer is gone anyway
+
+    def handle(self, frame: dict) -> None:
+        t = frame.get("t")
+        server = self.front.server
+        rid = frame.get("rid")
+        try:
+            if t == "connect":
+                conn = server.connect(
+                    frame["tenant"], frame["doc"], frame.get("details"))
+                self.conn = conn
+                conn.on_op = lambda m: self.push(
+                    "op", {"msg": message_to_dict(m)})
+                conn.on_nack = lambda n: self.push(
+                    "nack", {"nack": message_to_dict(n)})
+                conn.on_signal = lambda s: self.push(
+                    "signal", {"signal": message_to_dict(s)})
+                self.push("connected", {
+                    "rid": rid,
+                    "clientId": conn.client_id,
+                    "seq": conn.initial_sequence_number,
+                    "maxMessageSize": self.front.max_message_size,
+                })
+            elif t == "submit":
+                if self.conn is None:
+                    raise RuntimeError("submit before connect")
+                ops, oversized = [], []
+                for d in frame["ops"]:
+                    op = message_from_dict(d)
+                    if len(json.dumps(d).encode()) > self.front.max_message_size:
+                        oversized.append(op)
+                    else:
+                        ops.append(op)
+                for op in oversized:
+                    # nack without entering the pipeline (ref 16KB limit,
+                    # localDeltaConnectionServer.ts:96)
+                    self.push("nack", {"nack": message_to_dict(Nack(
+                        operation=op,
+                        sequence_number=-1,
+                        code=413,
+                        type=NackErrorType.BAD_REQUEST,
+                        message=f"message exceeds {self.front.max_message_size}"
+                                " byte limit",
+                    ))})
+                if ops:
+                    self.conn.submit(ops)
+            elif t == "signal":
+                if self.conn is None:
+                    raise RuntimeError("signal before connect")
+                self.conn.submit_signal(frame["content"],
+                                        frame.get("type", "signal"))
+            elif t == "disconnect":
+                if self.conn is not None:
+                    self.conn.disconnect()
+                    self.conn = None
+            elif t == "get_deltas":
+                msgs = server.get_deltas(
+                    frame["tenant"], frame["doc"], frame["from"], frame["to"])
+                self.push("deltas", {
+                    "rid": rid, "msgs": [message_to_dict(m) for m in msgs]})
+            elif t in ("get_versions", "get_tree", "read_blob",
+                       "write_blob", "upload_summary"):
+                self._handle_storage(t, frame, rid)
+            else:
+                raise ValueError(f"unknown frame type {t!r}")
+        except Exception as e:  # noqa: BLE001 — report, don't kill the loop
+            self.push("error", {"rid": rid, "message": str(e)})
+
+    def _handle_storage(self, t: str, frame: dict, rid) -> None:
+        from ..driver.local import LocalStorage
+
+        storage = LocalStorage(self.front.server, frame["tenant"], frame["doc"])
+        if t == "get_versions":
+            self.push("versions", {
+                "rid": rid,
+                "versions": storage.get_versions(frame.get("count", 1))})
+        elif t == "get_tree":
+            self.push("tree", {
+                "rid": rid,
+                "tree": storage.get_snapshot_tree(frame.get("version"))})
+        elif t == "read_blob":
+            self.push("blob", {
+                "rid": rid, "hex": storage.read_blob(frame["id"]).hex()})
+        elif t == "write_blob":
+            self.push("blob_id", {
+                "rid": rid,
+                "id": storage.write_blob(bytes.fromhex(frame["hex"]))})
+        elif t == "upload_summary":
+            self.push("version_id", {
+                "rid": rid,
+                "id": storage.upload_summary(frame["summary"],
+                                             frame.get("parent"))})
+
+    def closed(self) -> None:
+        if self.conn is not None:
+            self.conn.disconnect()
+            self.conn = None
+
+
+class NetworkFrontEnd:
+    """Owns the LocalServer pipeline and serves it over TCP.
+
+    ``start_background()`` runs the event loop (and thus the whole
+    pipeline) on a dedicated thread — the in-process deployment.
+    ``serve_forever()`` blocks — the subprocess deployment
+    (``python -m fluidframework_tpu.service.front_end``).
+    """
+
+    def __init__(self, server: Optional[LocalServer] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE):
+        self.server = server if server is not None else LocalServer()
+        self.host = host
+        self.port = port
+        self.max_message_size = max_message_size
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._aio_server: Optional[asyncio.base_events.Server] = None
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        session = _ClientSession(self, writer)
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                session.handle(frame)
+                await writer.drain()
+        except (ValueError, json.JSONDecodeError):
+            pass  # malformed stream: drop the connection
+        finally:
+            session.closed()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _start(self) -> None:
+        self._aio_server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._aio_server.sockets[0].getsockname()[1]
+        self._ready.set()
+
+    def start_background(self) -> "NetworkFrontEnd":
+        def run():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(self._start())
+            loop.run_forever()
+            # drain pending callbacks, then close
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="fluid-front-end")
+        self._thread.start()
+        self._ready.wait(timeout=10)
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            loop = self._loop
+
+            def _shutdown():
+                if self._aio_server is not None:
+                    self._aio_server.close()
+                loop.stop()
+
+            loop.call_soon_threadsafe(_shutdown)
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+            self._loop = None
+
+    def serve_forever(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(self._start())
+        # readiness marker for process supervisors / tests
+        print(f"LISTENING {self.host}:{self.port}", flush=True)
+        loop.run_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="Fluid TPU network front end")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--max-message-size", type=int,
+                        default=DEFAULT_MAX_MESSAGE_SIZE)
+    args = parser.parse_args()
+    NetworkFrontEnd(host=args.host, port=args.port,
+                    max_message_size=args.max_message_size).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,289 @@
+"""Service-path load generator (BASELINE config 4 analog).
+
+Ref: packages/test/service-load-test/src/nodeStressTest.ts + README.md:5-30
+— an orchestrator driving N synthetic SharedString clients against a live
+service, measuring end-to-end throughput and op-ack latency.
+
+The synthetic editor submits VALID merge-tree wire ops without running a
+full client replica: it tracks its own perspective's visible length from
+the broadcast stream (+insert len, −remove span — its tracked length is a
+lower bound on the true perspective length, so generated positions are
+always resolvable), which is O(1) per op. Ops are real chanop envelopes,
+so the TpuDocumentApplier can ride the same stream.
+
+Two harnesses:
+- ``run_inproc``: deli → scriptorium/scribe/broadcaster (+ optional
+  TpuDocumentApplier) all in-process — the pipeline-throughput number.
+- ``run_network``: clients on socket transports against a
+  NetworkFrontEnd — the REAL p99 op-ack latency number.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..protocol.messages import DocumentMessage, MessageType
+from .local_server import LocalServer
+
+DS_ID = "default"
+CHANNEL_ID = "text"
+
+
+def _percentile(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, max(0, int(round(p * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+@dataclass
+class LoadStats:
+    ops_submitted: int = 0
+    ops_acked: int = 0
+    seconds: float = 0.0
+    ack_latencies_ms: list[float] = field(default_factory=list)
+    applier_ops: int = 0
+    applier_escalations: int = 0
+
+    @property
+    def ops_per_sec(self) -> float:
+        return self.ops_submitted / self.seconds if self.seconds else 0.0
+
+    def latency_ms(self, p: float) -> float:
+        return _percentile(sorted(self.ack_latencies_ms), p)
+
+    def summary(self) -> dict:
+        return {
+            "ops": self.ops_submitted,
+            "acked": self.ops_acked,
+            "seconds": round(self.seconds, 3),
+            "ops_per_sec": round(self.ops_per_sec, 1),
+            "p50_ack_ms": round(self.latency_ms(0.50), 3),
+            "p99_ack_ms": round(self.latency_ms(0.99), 3),
+        }
+
+
+class SyntheticEditor:
+    """One synthetic client's op source for one document."""
+
+    def __init__(self, rng: random.Random, remove_fraction: float = 0.3,
+                 annotate_fraction: float = 0.05, max_insert: int = 8):
+        self.rng = rng
+        self.length = 0  # lower bound on this perspective's visible length
+        self.remove_fraction = remove_fraction
+        self.annotate_fraction = annotate_fraction
+        self.max_insert = max_insert
+        self.client_seq = 0
+        self.ref_seq = 0
+
+    def observe(self, msg) -> None:
+        """Track a broadcast sequenced message (anyone's, including own)."""
+        self.ref_seq = msg.sequence_number
+        if msg.type != MessageType.OPERATION:
+            return
+        env = msg.contents
+        if not isinstance(env, dict) or env.get("kind") != "chanop":
+            return
+        op = env["contents"]["contents"]
+        self._track(op)
+
+    def _track(self, op: dict) -> None:
+        if op["type"] == 0:
+            self.length += len(op.get("text") or "￼")
+        elif op["type"] == 1:
+            self.length -= op["end"] - op["start"]
+            if self.length < 0:
+                self.length = 0
+
+    def next_op(self) -> DocumentMessage:
+        r = self.rng.random()
+        if self.length > 4 and r < self.remove_fraction:
+            a = self.rng.randint(0, self.length - 2)
+            b = self.rng.randint(a + 1, min(self.length, a + self.max_insert))
+            op = {"type": 1, "start": a, "end": b}
+        elif self.length > 1 and r < self.remove_fraction + self.annotate_fraction:
+            a = self.rng.randint(0, self.length - 2)
+            b = self.rng.randint(a + 1, min(self.length, a + self.max_insert))
+            op = {"type": 2, "start": a, "end": b,
+                  "props": {"k": self.rng.randint(0, 3)}}
+        else:
+            n = self.rng.randint(1, self.max_insert)
+            text = "".join(self.rng.choice("abcdefgh") for _ in range(n))
+            op = {"type": 0, "pos": self.rng.randint(0, self.length),
+                  "text": text}
+        # own op visible to own perspective immediately
+        self._track(op)
+        self.client_seq += 1
+        return DocumentMessage(
+            client_sequence_number=self.client_seq,
+            reference_sequence_number=self.ref_seq,
+            type=MessageType.OPERATION,
+            contents={"kind": "chanop", "address": DS_ID,
+                      "contents": {"address": CHANNEL_ID, "contents": op}},
+        )
+
+
+def wire_applier(server: LocalServer, applier, tenant: str, docs: list[str]):
+    """Subscribe a TpuDocumentApplier to the live broadcast of each doc
+    (the scribe-position consumer of the sequenced stream)."""
+    from .broadcaster import BroadcasterLambda
+
+    def make_cb(doc):
+        def cb(msg):
+            if msg.type != MessageType.OPERATION:
+                return
+            env = msg.contents
+            if not isinstance(env, dict) or env.get("kind") != "chanop":
+                return
+            if env["address"] != DS_ID:
+                return
+            inner = env["contents"]
+            if inner.get("address") != CHANNEL_ID or "attach" in inner:
+                return
+            applier.ingest(tenant, doc, msg, inner["contents"])
+        return cb
+
+    for doc in docs:
+        server.pubsub.subscribe(
+            BroadcasterLambda.topic(tenant, doc), make_cb(doc))
+
+
+def run_inproc(
+    n_docs: int = 64,
+    clients_per_doc: int = 2,
+    ops_per_client: int = 50,
+    seed: int = 0,
+    applier=None,
+    flush_every: int = 256,
+    tenant: str = "bench",
+) -> LoadStats:
+    """Drive the full in-process pipeline at max rate; measure throughput.
+
+    Every submitted op passes deli ticketing, scriptorium persistence,
+    scribe protocol tracking, broadcast fan-out to every connected
+    client, and (optionally) the TPU applier's device batch.
+    """
+    rng = random.Random(seed)
+    server = LocalServer()
+    docs = [f"doc{i}" for i in range(n_docs)]
+    stats = LoadStats()
+
+    if applier is not None:
+        applier.set_replay_source(lambda t, d: [])
+        wire_applier(server, applier, tenant, docs)
+
+    sessions = []  # (conn, editor)
+    for doc in docs:
+        for _ in range(clients_per_doc):
+            conn = server.connect(tenant, doc)
+            editor = SyntheticEditor(rng)
+            # track every broadcast op EXCEPT own (already tracked at submit)
+            def on_op(msg, editor=editor, me=conn.client_id):
+                if msg.client_id == me:
+                    editor.ref_seq = msg.sequence_number
+                    stats.ops_acked += 1
+                else:
+                    editor.observe(msg)
+            conn.on_op = on_op
+            sessions.append((conn, editor))
+
+    total = len(sessions) * ops_per_client
+    since_flush = 0
+    t0 = time.perf_counter()
+    for i in range(ops_per_client):
+        for conn, editor in sessions:
+            conn.submit([editor.next_op()])
+            stats.ops_submitted += 1
+            since_flush += 1
+            if applier is not None and since_flush >= flush_every:
+                applier.flush()
+                since_flush = 0
+    if applier is not None:
+        applier.flush()
+    stats.seconds = time.perf_counter() - t0
+
+    if applier is not None:
+        stats.applier_ops = applier.ops_applied
+        stats.applier_escalations = applier.host_escalations
+    assert stats.ops_submitted == total
+    return stats
+
+
+def run_network(
+    port: int,
+    n_docs: int = 2,
+    clients_per_doc: int = 2,
+    ops_per_client: int = 100,
+    seed: int = 0,
+    tenant: str = "bench",
+    host: str = "127.0.0.1",
+    timeout: float = 60.0,
+    rate_hz: Optional[float] = None,
+) -> LoadStats:
+    """Drive socket clients against a live front end; measure op-ack
+    latency (submit → own op broadcast back) and throughput.
+
+    ``rate_hz`` paces each SUBMISSION ROUND (one op per client) — without
+    pacing the unbounded submit loop measures queueing depth, not service
+    latency (the north-star p99 < 50 ms is an at-load number, not a
+    saturation number)."""
+    from ..driver.network import NetworkDocumentServiceFactory
+
+    import threading
+
+    rng = random.Random(seed)
+    factory = NetworkDocumentServiceFactory(host, port)
+    stats = LoadStats()
+    # acks arrive on per-connection reader threads; unsynchronized
+    # read-modify-writes on the shared counters would drop increments
+    stats_lock = threading.Lock()
+    sessions = []
+
+    for d in range(n_docs):
+        doc = f"netdoc{d}"
+        for _ in range(clients_per_doc):
+            svc = factory.create_document_service(tenant, doc)
+            conn = svc.connect_to_delta_stream()
+            editor = SyntheticEditor(rng)
+            pending: dict[int, float] = {}  # clientSeq → send time
+
+            def on_op(msg, editor=editor, me=conn.client_id, pending=pending):
+                if msg.client_id == me:
+                    editor.ref_seq = msg.sequence_number
+                    sent = pending.pop(msg.client_sequence_number, None)
+                    with stats_lock:
+                        if sent is not None:
+                            stats.ack_latencies_ms.append(
+                                (time.perf_counter() - sent) * 1e3)
+                        stats.ops_acked += 1
+                else:
+                    editor.observe(msg)
+            conn.on_op = on_op
+            sessions.append((conn, editor, pending))
+
+    expected = len(sessions) * ops_per_client
+    t0 = time.perf_counter()
+    for i in range(ops_per_client):
+        if rate_hz is not None:
+            # absolute schedule so pacing error doesn't accumulate
+            target = t0 + i / rate_hz
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        for conn, editor, pending in sessions:
+            with conn.lock:
+                op = editor.next_op()
+                pending[op.client_sequence_number] = time.perf_counter()
+                conn.submit([op])
+            stats.ops_submitted += 1
+    # wait for all acks
+    deadline = time.time() + timeout
+    while stats.ops_acked < expected and time.time() < deadline:
+        time.sleep(0.002)
+    stats.seconds = time.perf_counter() - t0
+    for conn, _, _ in sessions:
+        conn.close()
+    return stats

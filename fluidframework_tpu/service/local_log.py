@@ -26,6 +26,10 @@ class OrderedLogBase:
     def __init__(self):
         self._subs: dict[str, list[tuple[Handler, list[int]]]] = {}
         self._order: list[str] = []
+        # topics that MAY have undelivered records (ordered set): drain is
+        # O(pending work), not O(topics) — at thousands of docs the
+        # scan-everything loop was the service hot spot
+        self._dirty: dict[str, None] = {}
 
     # ------------------------------------------------- storage primitives
 
@@ -48,11 +52,14 @@ class OrderedLogBase:
 
     def append(self, topic: str, value: Any, partition: int = 0) -> int:
         self.create_topic(topic)
-        return self._store(topic, value)
+        offset = self._store(topic, value)
+        self._dirty[topic] = None
+        return offset
 
     def subscribe(self, topic: str, handler: Handler, from_offset: int = 0) -> None:
         self.create_topic(topic)
         self._subs[topic].append((handler, [from_offset]))
+        self._dirty[topic] = None  # may need catch-up delivery
 
     def unsubscribe(self, topic: str, handler: Handler) -> None:
         subs = self._subs.get(topic, [])
@@ -73,11 +80,13 @@ class OrderedLogBase:
         runs to a fixed point. Returns the number of deliveries made.
         """
         delivered = 0
-        progressed = True
-        while progressed:
-            progressed = False
-            for topic in list(self._order):
-                for handler, pos in self._subs[topic]:
+        while self._dirty:
+            topic = next(iter(self._dirty))
+            del self._dirty[topic]
+            # handlers may subscribe/unsubscribe and append (re-dirtying
+            # this or other topics); the outer loop reaches the fixed point
+            try:
+                for handler, pos in list(self._subs.get(topic, [])):
                     while pos[0] < self._stored_length(topic):
                         msg = QueuedMessage(
                             offset=pos[0], topic=topic, partition=0,
@@ -85,7 +94,11 @@ class OrderedLogBase:
                         pos[0] += 1
                         handler(msg)
                         delivered += 1
-                        progressed = True
+            except Exception:
+                # a raising handler must not strand the topic's remaining
+                # records: re-dirty so the next drain() retries
+                self._dirty[topic] = None
+                raise
         return delivered
 
     def step(self, topic: str) -> bool:
