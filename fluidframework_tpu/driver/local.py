@@ -64,14 +64,28 @@ class LocalDeltaStorage(DocumentDeltaStorage):
 
 
 class LocalStorage(DocumentStorage):
-    """Content-addressed blob + versioned summary-tree store on the server
-    db (the gitrest/historian analog; trees/blobs keyed by sha, versions =
-    the ref chain)."""
+    """Versioned summary storage over the server's content-addressed blob
+    store (the gitrest/historian analog — the C++ chunk store when the
+    server has a storage dir; trees/blobs keyed by sha, versions = the
+    ref chain, scribe ack = the ref update).
+
+    Summary trees upload recursively (ref: summaryWriter.ts:69-192
+    writeClientSummary → createGitTree): each blob is content-addressed;
+    each tree node is a JSON blob of named child refs; a
+    ``SummaryHandle`` resolves to the PARENT version's subtree ref at
+    that path and re-uploads nothing (protocol-definitions summary.ts
+    incremental contract).
+
+    Stored tree-node format: {"t": "tree", "e": {name: {"k", "id"}}}.
+    """
 
     def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
         self._db = server.db
+        self._blobs = server.blob_store
+        self._stats = server.storage_stats
         self._versions_col = summary_versions_collection(tenant_id, document_id)
-        self._blobs_col = "blobs"
+
+    # ------------------------------------------------------------ versions
 
     def get_versions(self, count: int = 1) -> list[dict]:
         """Only scribe-ACKED versions are boot sources (the git-ref analog:
@@ -85,28 +99,53 @@ class LocalStorage(DocumentStorage):
         )
         return [{"id": v["_id"], "tree_id": v["tree_id"]} for v in versions[:count]]
 
+    # -------------------------------------------------------------- reads
+
     def get_snapshot_tree(self, version: Optional[dict] = None) -> Optional[dict]:
+        """Materialize a version into the plain nested summary dict the
+        container boots from (reads back through the chunk store)."""
         if version is None:
             versions = self.get_versions(1)
             if not versions:
                 return None
             version = versions[0]
-        blob = self.read_blob(version["tree_id"])
-        return json.loads(blob.decode())
+        ref = json.loads(self.read_blob(version["tree_id"]).decode())
+        if ref.get("t") != "tree":
+            return ref  # legacy single-blob summary
+        return self._materialize({"k": "tree", "id": version["tree_id"]})
+
+    def _materialize(self, ref: dict) -> Any:
+        if ref["k"] == "blob":
+            return json.loads(self.read_blob(ref["id"]).decode())
+        node = json.loads(self.read_blob(ref["id"]).decode())
+        return {name: self._materialize(child)
+                for name, child in node["e"].items()}
 
     def read_blob(self, blob_id: str) -> bytes:
-        doc = self._db.find_one(self._blobs_col, blob_id)
-        if doc is None:
-            raise KeyError(f"unknown blob {blob_id}")
-        return bytes.fromhex(doc["hex"])
+        return self._blobs.get(blob_id)
 
     def write_blob(self, content: bytes) -> str:
-        blob_id = hashlib.sha1(content).hexdigest()
-        self._db.upsert(self._blobs_col, blob_id, {"hex": content.hex()})
-        return blob_id
+        return self._blobs.put(content)
+
+    # ------------------------------------------------------------- uploads
 
     def upload_summary(self, summary: Any, parent: Optional[str]) -> str:
-        tree_id = self.write_blob(json.dumps(summary).encode())
+        from ..protocol.summary import (
+            SummaryObject,
+            SummaryTree,
+            is_summary_wire,
+            summary_from_wire,
+        )
+
+        if is_summary_wire(summary):
+            summary = summary_from_wire(summary)
+        if isinstance(summary, SummaryTree):
+            parent_root = self._version_root_ref(parent)
+            root_ref = self._upload_obj(summary, parent_root)
+            tree_id = root_ref["id"]
+        else:
+            # legacy monolithic dict
+            tree_id = self.write_blob(json.dumps(summary).encode())
         n = len(self._db.collection(self._versions_col))
         version_id = f"v{n}"
         self._db.upsert(
@@ -115,6 +154,59 @@ class LocalStorage(DocumentStorage):
             {"n": n, "tree_id": tree_id, "parent": parent},
         )
         return version_id
+
+    def _version_root_ref(self, version_id: Optional[str]) -> Optional[dict]:
+        if version_id is None:
+            return None
+        v = self._db.find_one(self._versions_col, version_id)
+        if v is None:
+            return None
+        return {"k": "tree", "id": v["tree_id"]}
+
+    def _upload_obj(self, obj, parent_root: Optional[dict]) -> dict:
+        from ..protocol.summary import (
+            SummaryAttachment,
+            SummaryBlob,
+            SummaryHandle,
+            SummaryTree,
+        )
+
+        if isinstance(obj, SummaryBlob):
+            self._stats["blobs_written"] += 1
+            return {"k": "blob", "id": self._blobs.put(obj.content)}
+        if isinstance(obj, SummaryAttachment):
+            return {"k": "blob", "id": obj.id}
+        if isinstance(obj, SummaryHandle):
+            if parent_root is None:
+                raise ValueError(
+                    f"summary handle {obj.handle!r} with no parent version")
+            ref = self._resolve_path(parent_root, obj.handle)
+            self._stats["handles_reused"] += 1
+            return ref
+        if isinstance(obj, SummaryTree):
+            entries = {
+                name: self._upload_obj(child, parent_root)
+                for name, child in obj.tree.items()
+            }
+            node = json.dumps({"t": "tree", "e": entries},
+                              sort_keys=True).encode()
+            self._stats["trees_written"] += 1
+            return {"k": "tree", "id": self._blobs.put(node)}
+        raise TypeError(f"not a summary object: {obj!r}")
+
+    def _resolve_path(self, root_ref: dict, path: str) -> dict:
+        """Walk stored tree nodes to the subtree ref a handle names.
+        Parent trees were themselves uploaded with handles resolved, so
+        the walk always lands on a concrete content id."""
+        ref = root_ref
+        for segment in path.strip("/").split("/"):
+            if ref["k"] != "tree":
+                raise KeyError(f"handle path {path!r}: {segment!r} is a blob")
+            node = json.loads(self._blobs.get(ref["id"]).decode())
+            if segment not in node["e"]:
+                raise KeyError(f"handle path {path!r}: no entry {segment!r}")
+            ref = node["e"][segment]
+        return ref
 
 
 class LocalDocumentService(DocumentService):

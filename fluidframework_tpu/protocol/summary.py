@@ -67,6 +67,38 @@ class SummaryTree:
 SummaryObject = Union[SummaryTree, SummaryBlob, SummaryHandle, SummaryAttachment]
 
 
+def summary_to_wire(obj: SummaryObject) -> dict:
+    """JSON-safe encoding (network storage RPC carries summary trees)."""
+    if isinstance(obj, SummaryTree):
+        return {"__summary__": "tree",
+                "tree": {k: summary_to_wire(v) for k, v in obj.tree.items()}}
+    if isinstance(obj, SummaryBlob):
+        return {"__summary__": "blob", "hex": obj.content.hex()}
+    if isinstance(obj, SummaryHandle):
+        return {"__summary__": "handle", "handle": obj.handle}
+    if isinstance(obj, SummaryAttachment):
+        return {"__summary__": "attachment", "id": obj.id}
+    raise TypeError(f"not a summary object: {obj!r}")
+
+
+def summary_from_wire(d: dict) -> SummaryObject:
+    kind = d["__summary__"]
+    if kind == "tree":
+        return SummaryTree(
+            tree={k: summary_from_wire(v) for k, v in d["tree"].items()})
+    if kind == "blob":
+        return SummaryBlob(content=bytes.fromhex(d["hex"]))
+    if kind == "handle":
+        return SummaryHandle(handle=d["handle"])
+    if kind == "attachment":
+        return SummaryAttachment(id=d["id"])
+    raise ValueError(f"unknown summary wire kind {kind!r}")
+
+
+def is_summary_wire(d) -> bool:
+    return isinstance(d, dict) and "__summary__" in d
+
+
 @dataclass
 class SummaryProposal:
     """Body of a MessageType.SUMMARIZE op (ref: protocol.ts:198-260)."""

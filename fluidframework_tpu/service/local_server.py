@@ -104,12 +104,26 @@ class LocalServer:
         clock: Callable[[], float] = time.time,
         client_timeout: Optional[float] = None,
         log=None,
+        storage_dir: Optional[str] = None,
     ):
         # any object with the LocalLog surface works — pass a DurableLog
         # to persist the pipeline across process restarts
         self.log = log if log is not None else LocalLog()
         self.db = InMemoryDb()
         self.pubsub = PubSub()
+        # content-addressed blob store: native C++ chunk store when given
+        # a directory (the gitrest/libgit2 role), else db-backed
+        if storage_dir is not None:
+            from .blob_store import NativeBlobStore
+
+            self.blob_store = NativeBlobStore(storage_dir)
+        else:
+            from .blob_store import DbBlobStore
+
+            self.blob_store = DbBlobStore(self.db)
+        # summary-upload accounting (handle reuse), per server
+        self.storage_stats = {"handles_reused": 0, "trees_written": 0,
+                              "blobs_written": 0}
         self._orderers: dict[str, LocalOrderer] = {}
         self._auto_drain = auto_drain
         self._clock = clock
