@@ -125,6 +125,11 @@ def _shift1(a):
     return jnp.roll(a, 1, axis=0)
 
 
+def _shift2(a):
+    """out[i] = a[i-2] (out[0..1] are never selected by callers)."""
+    return jnp.roll(a, 2, axis=0)
+
+
 def _fieldwise(state: DocState, fn, count, overflow) -> DocState:
     return DocState(
         **{name: fn(name, getattr(state, name)) for name in _SLOT_FIELDS},
@@ -133,63 +138,27 @@ def _fieldwise(state: DocState, fn, count, overflow) -> DocState:
     )
 
 
-def _split_at(state: DocState, pos, ref_seq, client) -> DocState:
-    """Split the segment strictly containing visible position ``pos``
-    (no-op when pos falls on a boundary). Both halves keep identical
-    stamps, flags, and properties (ref: BaseSegment.splitAt).
-
-    Gather-free: the rebuild is a static roll-by-one plus selects (TPU
-    gathers with computed indices are the slow path; rolls and selects
-    vectorize onto the VPU).
-    """
-    S = state.max_slots
-    vis, vlen, cum = _visibility(state, ref_seq, client)
-    inside = vis & (cum < pos) & (pos < cum + vlen)
-    has = jnp.any(inside)
-    j = jnp.argmax(inside)
-    o = pos - cum[j]
-
-    i = jnp.arange(S, dtype=jnp.int32)
-    keep = ~has | (i <= j)  # slots at/before the split point stay put
-    is_tail = has & (i == j + 1)
-
-    def rebuild(name, a):
-        aj = a[j]  # scalar (or [P] row) dynamic read — cheap
-        if a.ndim == 2:
-            return jnp.where(keep[:, None], a,
-                             jnp.where(is_tail[:, None], aj[None, :],
-                                       _shift1(a)))
-        out = jnp.where(keep, a, jnp.where(is_tail, aj, _shift1(a)))
-        if name == "length":
-            out = jnp.where(has & (i == j), o, out)
-            out = jnp.where(is_tail, state.length[j] - o, out)
-        elif name == "text_start":
-            out = jnp.where(is_tail, state.text_start[j] + o, out)
-        return out
-
-    return _fieldwise(
-        state,
-        rebuild,
-        count=state.count + has.astype(jnp.int32),
-        overflow=state.overflow | (has & (state.count + 1 > S)),
-    )
-
-
 def _apply_unified(state: DocState, op) -> DocState:
-    """One shared path for insert/remove/annotate (noop passes through):
+    """One shared path for insert/remove/annotate (noop passes through),
+    FUSED: a single visibility/prefix-sum pass and a single roll+select
+    rebuild cover both potential splits and the insert shift.
 
-    1. split at pos/start, split at end (no-ops on boundaries — for an
-       insert both land on the same boundary, so neither splits twice);
-    2. insert: shift-open a slot at the earliest boundary reaching pos
-       (lands BEFORE tombstone runs, matching MergeTree.resolve) and
-       stamp it;
-    3. remove: mask-stamp covered slots (overlap keeps earliest stamp,
-       this client records as additional remover);
-    4. annotate: LWW per-key write into the covered slots' prop tables.
+    An op creates at most two new slots — the tail halves of up to two
+    splits, or one tail half plus the inserted segment — so every output
+    slot is one of {a[o], a[o-1], a[o-2]} (gather-free: static rolls and
+    selects vectorize onto the VPU; computed-index gathers are the TPU
+    slow path), plus point patches at the split/insert indices. The
+    sequential form (split(p1) → split(p2) → insert-shift, each with its
+    own visibility recompute) cost 4 prefix-sum passes and 3 full-state
+    rebuilds per op; fused it is 1 and 1, which is what sets the K-step
+    scan's per-op device cost.
 
-    A single structure (vs. a lax.switch of four bodies) matters under
-    vmap: batched switch lowers to executing every branch and selecting,
-    so shared work would otherwise be paid four times.
+    Semantics (unchanged, fuzz-checked against the scalar oracle):
+    - insert lands at the EARLIEST boundary reaching pos (before
+      tombstone runs, matching MergeTree.resolve + breakTie);
+    - remove mask-stamps covered slots (overlap keeps the earliest
+      stamp, later removers recorded as extra remove clients);
+    - annotate is LWW per key into per-slot property tables.
     """
     S = state.max_slots
     typ = op[F_TYPE]
@@ -201,42 +170,62 @@ def _apply_unified(state: DocState, op) -> DocState:
     seq, ref_seq, client = op[F_SEQ], op[F_REFSEQ], op[F_CLIENT]
     p2 = jnp.where(is_ins, pos, end)
 
-    vis0, vlen0, cum0 = _visibility(state, ref_seq, client)
-    total = jnp.sum(vlen0)
+    vis, vlen, cum = _visibility(state, ref_seq, client)  # THE prefix pass
+    total = jnp.sum(vlen)
     bad_shape = jnp.where(is_ins, pos > total, (end > total) | (end <= pos))
-    # exact slot demand: a split only happens when the position falls
-    # STRICTLY inside a visible segment (adding the start boundary cannot
-    # move the end strictly inside/outside a segment, so the pre-split
-    # test is exact for both)
-    inc0 = cum0 + vlen0
+    inc = cum + vlen
 
-    def strictly_inside(p):
-        return jnp.any(vis0 & (cum0 < p) & (p < inc0)).astype(jnp.int32)
-
+    # split demand: a split happens iff the position falls STRICTLY
+    # inside a visible segment (exact on the pre-split state: adding the
+    # p1 boundary cannot move p2 strictly into/out of a segment)
+    inside1 = vis & (cum < pos) & (pos < inc)
+    inside2 = vis & (cum < p2) & (p2 < inc)
+    s1_raw = jnp.any(inside1)
+    s2_raw = (~is_ins) & jnp.any(inside2)
     needed = jnp.where(
         is_ins,
-        1 + strictly_inside(pos),
-        strictly_inside(pos) + strictly_inside(end),
+        1 + s1_raw.astype(jnp.int32),
+        s1_raw.astype(jnp.int32) + s2_raw.astype(jnp.int32),
     )
     bad = active & (bad_shape | (state.count + needed > S))
-    # a bad/inactive op must not split: clamp positions to 0 (never
-    # strictly inside a segment) so both splits no-op
-    p1s = jnp.where(active & ~bad, pos, 0)
-    p2s = jnp.where(active & ~bad, p2, 0)
+    ok = active & ~bad
+    s1 = s1_raw & ok
+    s2 = s2_raw & ok
+    do_ins = is_ins & ok
 
-    st = _split_at(state, p1s, ref_seq, client)
-    st = _split_at(st, p2s, ref_seq, client)
+    j1 = jnp.argmax(inside1)
+    j2 = jnp.argmax(inside2)
+    o1 = pos - cum[j1]
+    o2 = p2 - cum[j2]
+    l1, ts1 = state.length[j1], state.text_start[j1]
+    l2, ts2 = state.length[j2], state.text_start[j2]
+    same = s1 & s2 & (j1 == j2)  # both splits inside one segment
 
-    vis, vlen, cum = _visibility(st, ref_seq, client)
+    # output indices of the new/patched slots
+    s1i = s1.astype(jnp.int32)
+    idx0 = jnp.argmax(cum >= pos)  # earliest boundary (unused slots keep
+    # cum == total, so append-at-end resolves to the first free slot)
+    p_ins = jnp.where(s1, j1 + 1, idx0)  # new insert slot
+    p_n1 = jnp.where(do_ins, p_ins + 1, j1 + 1)  # tail half of split 1
+    p_h2 = j2 + s1i  # original j2 (head half of split 2), shifted past n1
+    p_n2 = j2 + 1 + s1i  # tail half of split 2
+
     i = jnp.arange(S, dtype=jnp.int32)
+    # shift = how many new slots sit at/before each output index
+    delta = (
+        (s1 & (i >= p_n1)).astype(jnp.int32)
+        + (s2 & (i >= p_n2)).astype(jnp.int32)
+        + (do_ins & (i >= p_ins)).astype(jnp.int32)
+    )
+    d1 = delta == 1
+    d2 = delta == 2
+    head1_at = s1 & (i == j1)
+    n1_at = s1 & (i == p_n1)
+    h2_at = s2 & ~same & (i == p_h2)
+    n2_at = s2 & (i == p_n2)
+    new_at = do_ins & (i == p_ins)
 
-    # ---- insert: open a slot at idx and stamp it
-    do_ins = is_ins & ~bad
-    idx = jnp.argmax(cum >= pos)  # earliest boundary (post-split)
     tlen, tstart = op[F_TLEN], op[F_TSTART]
-    shift = do_ins & (i > idx)
-    new = do_ins & (i == idx)
-
     new_vals = {
         "length": jnp.where(tlen > 0, tlen, 1),
         "text_start": tstart,
@@ -247,26 +236,45 @@ def _apply_unified(state: DocState, op) -> DocState:
         "rem_client_a": NO_CLIENT,
         "rem_client_b": NO_CLIENT,
     }
+    # length/text_start patches for the four split-derived slots
+    n1_len = jnp.where(same, o2 - o1, l1 - o1)
+    patch_len = [(head1_at, o1), (n1_at, n1_len), (h2_at, o2),
+                 (n2_at, l2 - o2)]
+    patch_ts = [(n1_at, ts1 + o1), (n2_at, ts2 + o2)]
 
-    def insert_shift(name, a):
-        if a.ndim == 2:  # prop tables: new slot starts empty
+    def rebuild(name, a):
+        if a.ndim == 2:  # prop tables: roll rows, new insert slot empty
             fill = NO_KEY if name == "prop_key" else 0
-            out = jnp.where(shift[:, None], _shift1(a), a)
-            return jnp.where(new[:, None], fill, out)
-        out = jnp.where(shift, _shift1(a), a)
-        return jnp.where(new, new_vals[name], out)
+            out = jnp.where(d1[:, None], _shift1(a),
+                            jnp.where(d2[:, None], _shift2(a), a))
+            return jnp.where(new_at[:, None], fill, out)
+        out = jnp.where(d1, _shift1(a), jnp.where(d2, _shift2(a), a))
+        if name == "length":
+            for mask, val in patch_len:
+                out = jnp.where(mask, val, out)
+        elif name == "text_start":
+            for mask, val in patch_ts:
+                out = jnp.where(mask, val, out)
+        return jnp.where(new_at, new_vals[name], out) if name in new_vals \
+            else out
 
     st = _fieldwise(
-        st,
-        insert_shift,
-        count=st.count + do_ins.astype(jnp.int32),
-        overflow=st.overflow,
+        state,
+        rebuild,
+        count=state.count + s1i + s2.astype(jnp.int32)
+        + do_ins.astype(jnp.int32),
+        overflow=state.overflow,
     )
 
-    # ---- remove/annotate target mask. The post-split (pre-insert)
-    # prefix is correct here: the insert shift only runs when do_ins,
-    # in which case this mask is dead — no recompute needed
-    covered = vis & (cum >= pos) & (cum + vlen <= end)
+    # ---- remove/annotate target mask, on ROLLED perspective arrays (no
+    # second prefix pass). The insert slot never matters here: do_ins
+    # excludes is_rem/is_ann, so the mask is dead in that case.
+    vis_out = jnp.where(d1, _shift1(vis), jnp.where(d2, _shift2(vis), vis))
+    cum_out = jnp.where(d1, _shift1(cum), jnp.where(d2, _shift2(cum), cum))
+    cum_out = jnp.where(n1_at, cum[j1] + o1, cum_out)
+    cum_out = jnp.where(n2_at, cum[j2] + o2, cum_out)
+    vlen_out = jnp.where(vis_out, st.length, 0)
+    covered = vis_out & (cum_out >= pos) & (cum_out + vlen_out <= end)
     rm = is_rem & ~bad & covered
     fresh = rm & (st.rem_seq == NO_SEQ)
     # overlap: ops apply in seq order so the existing stamp is the

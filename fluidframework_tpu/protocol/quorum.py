@@ -234,6 +234,34 @@ class ProtocolOpHandler:
         )
         return True
 
+    def observe_operation_run(
+        self, first_seq: int, last_seq: int, final_msn: int
+    ) -> bool:
+        """Apply a contiguous run of plain OPERATION messages in one step.
+
+        The batched fast lane (service/deli.py boxcars) delivers runs that
+        contain no membership/proposal messages, so the replica's only
+        state change is the head/window advance. Settling proposals once
+        with the run's final msn commits exactly the set the per-op path
+        would (rejections can only arrive via REJECT messages, which never
+        ride these runs). Handles replay overlap like process_message:
+        a run entirely below the head is a duplicate (returns False); a
+        partial overlap advances from the head.
+        """
+        if last_seq <= self.sequence_number:
+            return False
+        if first_seq > self.sequence_number + 1:
+            raise ProtocolError(
+                f"sequence gap: have {self.sequence_number}, run starts at {first_seq}"
+            )
+        self.sequence_number = last_seq
+        if final_msn > self.minimum_sequence_number:
+            self.minimum_sequence_number = final_msn
+        self.quorum.update_minimum_sequence_number(
+            self.minimum_sequence_number, self.sequence_number
+        )
+        return True
+
     def snapshot(self) -> dict:
         return {
             "minimumSequenceNumber": self.minimum_sequence_number,

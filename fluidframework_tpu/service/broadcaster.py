@@ -33,7 +33,13 @@ class PubSub:
 
 
 class BroadcasterLambda:
-    """Relays each sequenced message to the doc's pub/sub topic."""
+    """Relays sequenced messages to the doc's pub/sub topic in batches.
+
+    The op-topic contract is ``callback(list[SequencedDocumentMessage])``
+    — the reference broadcaster likewise accumulates per-doc batches
+    before publishing (lambda.ts:29-80), which is what keeps fan-out cost
+    per-batch instead of per-op at high throughput.
+    """
 
     def __init__(self, pubsub: PubSub):
         self._pubsub = pubsub
@@ -43,10 +49,12 @@ class BroadcasterLambda:
         return f"{tenant_id}/{document_id}"
 
     def handler(self, message: QueuedMessage) -> None:
-        envelope = message.value  # {"tenant_id", "document_id", "message"}
-        msg: SequencedDocumentMessage = envelope["message"]
+        envelope = message.value  # {"tenant_id", "document_id", "message"|"boxcar"}
+        batch = envelope.get("boxcar")
+        if batch is None:
+            batch = [envelope["message"]]
         self._pubsub.publish(
-            self.topic(envelope["tenant_id"], envelope["document_id"]), msg
+            self.topic(envelope["tenant_id"], envelope["document_id"]), batch
         )
 
     def close(self) -> None:

@@ -66,8 +66,16 @@ class LoadStats:
         }
 
 
+_TEXT_POOL = "abcdefgh" * 4  # payload source: slicing beats per-char joins
+
+
 class SyntheticEditor:
-    """One synthetic client's op source for one document."""
+    """One synthetic client's op source for one document.
+
+    Generation is deliberately cheap (single ``random()`` draws scaled to
+    ranges, pooled payload text): at the north-star rate the generator
+    runs inside the measured loop, so its cost is part of the headline.
+    """
 
     def __init__(self, rng: random.Random, remove_fraction: float = 0.3,
                  annotate_fraction: float = 0.05, max_insert: int = 8):
@@ -85,65 +93,86 @@ class SyntheticEditor:
         if msg.type != MessageType.OPERATION:
             return
         env = msg.contents
-        if not isinstance(env, dict) or env.get("kind") != "chanop":
+        if type(env) is not dict or env.get("kind") != "chanop":
             return
         op = env["contents"]["contents"]
         self._track(op)
 
     def _track(self, op: dict) -> None:
-        if op["type"] == 0:
+        t = op["type"]
+        if t == 0:
             self.length += len(op.get("text") or "￼")
-        elif op["type"] == 1:
+        elif t == 1:
             self.length -= op["end"] - op["start"]
             if self.length < 0:
                 self.length = 0
 
+    def next_ops(self, count: int) -> list[DocumentMessage]:
+        """Generate a submission batch (one outbound boxcar)."""
+        rnd = self.rng.random
+        rm, ann, mi = self.remove_fraction, self.annotate_fraction, self.max_insert
+        ref_seq = self.ref_seq
+        cseq = self.client_seq
+        out = []
+        for _ in range(count):
+            r = rnd()
+            length = self.length
+            if length > 4 and r < rm:
+                a = int(rnd() * (length - 1))
+                b = a + 1 + int(rnd() * min(length - a - 1, mi - 1))
+                op = {"type": 1, "start": a, "end": b}
+                self.length = length - (b - a)
+            elif length > 1 and r < rm + ann:
+                a = int(rnd() * (length - 1))
+                b = a + 1 + int(rnd() * min(length - a - 1, mi - 1))
+                op = {"type": 2, "start": a, "end": b,
+                      "props": {"k": int(rnd() * 4)}}
+            else:
+                n = 1 + int(rnd() * mi)
+                off = int(rnd() * 8)
+                op = {"type": 0, "pos": int(rnd() * (length + 1)),
+                      "text": _TEXT_POOL[off:off + n]}
+                self.length = length + n
+            cseq += 1
+            out.append(DocumentMessage(
+                client_sequence_number=cseq,
+                reference_sequence_number=ref_seq,
+                type=MessageType.OPERATION,
+                contents={"kind": "chanop", "address": DS_ID,
+                          "contents": {"address": CHANNEL_ID, "contents": op}},
+            ))
+        self.client_seq = cseq
+        return out
+
     def next_op(self) -> DocumentMessage:
-        r = self.rng.random()
-        if self.length > 4 and r < self.remove_fraction:
-            a = self.rng.randint(0, self.length - 2)
-            b = self.rng.randint(a + 1, min(self.length, a + self.max_insert))
-            op = {"type": 1, "start": a, "end": b}
-        elif self.length > 1 and r < self.remove_fraction + self.annotate_fraction:
-            a = self.rng.randint(0, self.length - 2)
-            b = self.rng.randint(a + 1, min(self.length, a + self.max_insert))
-            op = {"type": 2, "start": a, "end": b,
-                  "props": {"k": self.rng.randint(0, 3)}}
-        else:
-            n = self.rng.randint(1, self.max_insert)
-            text = "".join(self.rng.choice("abcdefgh") for _ in range(n))
-            op = {"type": 0, "pos": self.rng.randint(0, self.length),
-                  "text": text}
-        # own op visible to own perspective immediately
-        self._track(op)
-        self.client_seq += 1
-        return DocumentMessage(
-            client_sequence_number=self.client_seq,
-            reference_sequence_number=self.ref_seq,
-            type=MessageType.OPERATION,
-            contents={"kind": "chanop", "address": DS_ID,
-                      "contents": {"address": CHANNEL_ID, "contents": op}},
-        )
+        return self.next_ops(1)[0]
 
 
 def wire_applier(server: LocalServer, applier, tenant: str, docs: list[str]):
     """Subscribe a TpuDocumentApplier to the live broadcast of each doc
-    (the scribe-position consumer of the sequenced stream)."""
+    (the scribe-position consumer of the sequenced stream). Op topics
+    carry batches; the applier stages each batch in one call."""
     from .broadcaster import BroadcasterLambda
 
+    op_t = MessageType.OPERATION
+
     def make_cb(doc):
-        def cb(msg):
-            if msg.type != MessageType.OPERATION:
-                return
-            env = msg.contents
-            if not isinstance(env, dict) or env.get("kind") != "chanop":
-                return
-            if env["address"] != DS_ID:
-                return
-            inner = env["contents"]
-            if inner.get("address") != CHANNEL_ID or "attach" in inner:
-                return
-            applier.ingest(tenant, doc, msg, inner["contents"])
+        def cb(batch):
+            pairs = []
+            for msg in batch:
+                if msg.type is not op_t:
+                    continue
+                env = msg.contents
+                if type(env) is not dict or env.get("kind") != "chanop":
+                    continue
+                if env["address"] != DS_ID:
+                    continue
+                inner = env["contents"]
+                if inner.get("address") != CHANNEL_ID or "attach" in inner:
+                    continue
+                pairs.append((msg, inner["contents"]))
+            if pairs:
+                applier.ingest_batch(tenant, doc, pairs)
         return cb
 
     for doc in docs:
@@ -159,12 +188,17 @@ def run_inproc(
     applier=None,
     flush_every: int = 256,
     tenant: str = "bench",
+    batch_size: int = 1,
 ) -> LoadStats:
     """Drive the full in-process pipeline at max rate; measure throughput.
 
     Every submitted op passes deli ticketing, scriptorium persistence,
     scribe protocol tracking, broadcast fan-out to every connected
     client, and (optionally) the TPU applier's device batch.
+
+    ``batch_size``: ops each client submits per round as one boxcar (the
+    outbound DeltaQueue flush / Kafka boxcar analog). ``ops_per_client``
+    must be a multiple of it.
     """
     rng = random.Random(seed)
     server = LocalServer()
@@ -181,28 +215,34 @@ def run_inproc(
             conn = server.connect(tenant, doc)
             editor = SyntheticEditor(rng)
             # track every broadcast op EXCEPT own (already tracked at submit)
-            def on_op(msg, editor=editor, me=conn.client_id):
-                if msg.client_id == me:
-                    editor.ref_seq = msg.sequence_number
-                    stats.ops_acked += 1
-                else:
-                    editor.observe(msg)
-            conn.on_op = on_op
+            def on_ops(batch, editor=editor, me=conn.client_id):
+                acked = 0
+                for msg in batch:
+                    if msg.client_id == me:
+                        editor.ref_seq = msg.sequence_number
+                        acked += 1
+                    else:
+                        editor.observe(msg)
+                stats.ops_acked += acked
+            conn.on_ops = on_ops
             sessions.append((conn, editor))
 
+    assert ops_per_client % batch_size == 0
+    rounds = ops_per_client // batch_size
     total = len(sessions) * ops_per_client
     since_flush = 0
     t0 = time.perf_counter()
-    for i in range(ops_per_client):
+    for i in range(rounds):
         for conn, editor in sessions:
-            conn.submit([editor.next_op()])
-            stats.ops_submitted += 1
-            since_flush += 1
+            conn.submit(editor.next_ops(batch_size))
+            stats.ops_submitted += batch_size
+            since_flush += batch_size
             if applier is not None and since_flush >= flush_every:
                 applier.flush()
                 since_flush = 0
     if applier is not None:
         applier.flush()
+        applier.finalize()
     stats.seconds = time.perf_counter() - t0
 
     if applier is not None:

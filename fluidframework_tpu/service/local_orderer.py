@@ -85,6 +85,7 @@ class LocalOrderer:
             send_nack=self._on_nack,
             checkpoint=checkpoint,
             send_raw=self.order,
+            send_sequenced_batch=self._on_sequenced_batch,
             **kw,
         )
         self.scriptorium = ScriptoriumLambda(db)
@@ -116,8 +117,9 @@ class LocalOrderer:
         for topic, handler, from_offset in self._subscriptions:
             self._log.subscribe(topic, handler, from_offset=from_offset)
 
-    # the front end calls this (alfred's connection.order())
-    def order(self, raw: RawMessage) -> None:
+    # the front end calls this (alfred's connection.order()); accepts a
+    # single RawMessage or a RawBoxcar (one log record either way)
+    def order(self, raw) -> None:
         self._log.append(self.raw_topic, raw)
 
     def close(self) -> None:
@@ -147,6 +149,18 @@ class LocalOrderer:
                 "tenant_id": self.tenant_id,
                 "document_id": self.document_id,
                 "message": msg,
+            },
+        )
+
+    def _on_sequenced_batch(self, msgs: list[SequencedDocumentMessage]) -> None:
+        """A ticketed boxcar rides the deltas topic as one record, so the
+        downstream stages (scriptorium/scribe/broadcaster) batch too."""
+        self._log.append(
+            self.deltas_topic,
+            {
+                "tenant_id": self.tenant_id,
+                "document_id": self.document_id,
+                "boxcar": msgs,
             },
         )
 

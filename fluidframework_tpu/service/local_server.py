@@ -28,7 +28,7 @@ from ..protocol.messages import (
 )
 from .broadcaster import BroadcasterLambda, PubSub
 from .core import InMemoryDb
-from .deli import RawMessage
+from .deli import RawBoxcar, RawMessage
 from .local_log import LocalLog
 from .local_orderer import LocalOrderer
 
@@ -36,10 +36,13 @@ from .local_orderer import LocalOrderer
 class ServerConnection:
     """One client's live connection (the socket analog).
 
-    Callbacks: ``on_op(SequencedDocumentMessage)``, ``on_nack(Nack)``,
-    ``on_signal(Signal)``. Events arriving before a callback is attached
-    are buffered and flushed on attach, so nothing delivered between the
-    handshake and handler registration is lost.
+    Callbacks: ``on_op(SequencedDocumentMessage)`` per message, or
+    ``on_ops(list[SequencedDocumentMessage])`` per broadcast batch (set
+    one; ``on_ops`` wins when both are set — high-rate consumers want the
+    batch form), plus ``on_nack(Nack)`` and ``on_signal(Signal)``. Events
+    arriving before a callback is attached are buffered and flushed on
+    attach, so nothing delivered between the handshake and handler
+    registration is lost.
     """
 
     def __init__(self, server: "LocalServer", tenant_id: str, document_id: str,
@@ -50,7 +53,8 @@ class ServerConnection:
         self.client_id = client_id
         self.details = details
         self._handlers: dict[str, Optional[Callable]] = {
-            "op": None, "nack": None, "signal": None}
+            "op": None, "ops": None, "nack": None, "signal": None}
+        # op events buffer as batches; nack/signal as single events
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
         self.connected = True
         # sequence state at connect time (ref: IConnected payload)
@@ -63,9 +67,27 @@ class ServerConnection:
         else:
             cb(event)
 
+    def _deliver_ops(self, batch: list) -> None:
+        cb = self._handlers["ops"]
+        if cb is not None:
+            cb(batch)
+            return
+        cb = self._handlers["op"]
+        if cb is None:
+            self._buffers["op"].append(batch)
+        else:
+            for msg in batch:
+                cb(msg)
+
     def _set_handler(self, kind: str, cb: Optional[Callable]) -> None:
         self._handlers[kind] = cb
-        if cb is not None:
+        if cb is None:
+            return
+        if kind in ("op", "ops"):
+            pending, self._buffers["op"] = self._buffers["op"], []
+            for batch in pending:
+                self._deliver_ops(batch)
+        else:
             pending, self._buffers[kind] = self._buffers[kind], []
             for event in pending:
                 cb(event)
@@ -73,6 +95,9 @@ class ServerConnection:
     on_op = property(
         lambda self: self._handlers["op"],
         lambda self, cb: self._set_handler("op", cb))
+    on_ops = property(
+        lambda self: self._handlers["ops"],
+        lambda self, cb: self._set_handler("ops", cb))
     on_nack = property(
         lambda self: self._handlers["nack"],
         lambda self, cb: self._set_handler("nack", cb))
@@ -150,7 +175,7 @@ class LocalServer:
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
 
         topic = BroadcasterLambda.topic(tenant_id, document_id)
-        conn._op_cb = lambda msg: conn._deliver("op", msg)
+        conn._op_cb = conn._deliver_ops  # op topics carry batches
         conn._nack_cb = lambda nack: conn._deliver("nack", nack)
         conn._sig_cb = lambda sig: conn._deliver("signal", sig)
         self.pubsub.subscribe(topic, conn._op_cb)
@@ -228,16 +253,17 @@ class LocalServer:
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)
         now = self._clock()
-        for op in messages:
-            orderer.order(
-                RawMessage(
-                    tenant_id=conn.tenant_id,
-                    document_id=conn.document_id,
-                    client_id=conn.client_id,
-                    operation=op,
-                    timestamp=now,
-                )
+        # the whole submitted batch rides the raw log as ONE boxcar record
+        # (ref: IBoxcarMessage); deli's fast lane tickets it in one pass
+        orderer.order(
+            RawBoxcar(
+                tenant_id=conn.tenant_id,
+                document_id=conn.document_id,
+                client_id=conn.client_id,
+                ops=messages,
+                timestamp=now,
             )
+        )
         self._maybe_drain()
 
     def _signal(self, conn: ServerConnection, signal: Signal) -> None:

@@ -58,6 +58,19 @@ class ScribeLambda:
         if message.offset <= self.last_offset:
             return  # replay after restart
         self.last_offset = message.offset
+        batch = message.value.get("boxcar")
+        if batch is not None:
+            # boxcars are plain-operation runs by construction (the deli
+            # fast lane emits them); the replica only needs the window
+            # advanced once per run — proposals the window passes settle
+            # identically (values are order-independent; approval_seq is
+            # not persisted in snapshots)
+            self.protocol.observe_operation_run(
+                batch[0].sequence_number,
+                batch[-1].sequence_number,
+                batch[-1].minimum_sequence_number,
+            )
+            return
         msg: SequencedDocumentMessage = message.value["message"]
         # deli crash-replay re-appends already-sequenced records at NEW
         # topic offsets, so the offset gate above doesn't catch them;

@@ -36,7 +36,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..mergetree.client import MergeTreeClient
-from ..mergetree.ops import AnnotateOp, GroupOp, InsertOp, RemoveOp, op_from_wire
 from ..ops.apply import (
     NO_VAL,
     OP_ANNOTATE,
@@ -45,7 +44,6 @@ from ..ops.apply import (
     OP_REMOVE,
     apply_ops_batch,
     compact_batch,
-    make_op,
     wave_min_seq,
 )
 from ..ops.doc_state import FLAG_MARKER, DocState, PropTable, TextArena, decode_state
@@ -57,6 +55,27 @@ MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 # interned id for server/system-originated stamps (never collides with the
 # dense per-doc table, which grows upward from 0)
 SYSTEM_CLIENT = (1 << 30) - 1
+
+# jitted dense steps shared across applier instances, keyed (D, K):
+# per-instance closures would each re-trace/re-compile every shape bucket
+_DENSE_STEP_CACHE: dict = {}
+
+
+def _dense_step_for(D: int, K: int):
+    fn = _DENSE_STEP_CACHE.get((D, K))
+    if fn is None:
+        def dense_step(state, flat, doc_idx, pos_idx):
+            wave = (
+                jnp.zeros((D, K, OP_FIELDS), jnp.int32)
+                .at[doc_idx, pos_idx]
+                .set(flat, mode="drop")  # padding rows carry doc_idx=D
+            )
+            state = apply_ops_batch(state, wave)
+            return compact_batch(state, wave_min_seq(wave)), {}
+
+        fn = jax.jit(dense_step, donate_argnums=(0,))
+        _DENSE_STEP_CACHE[(D, K)] = fn
+    return fn
 
 
 def channel_stream(server, tenant_id: str, document_id: str,
@@ -87,10 +106,22 @@ class TpuDocumentApplier:
         max_slots: int = 256,
         ops_per_dispatch: int = 16,
         mesh=None,
+        overflow_check_every: int = 64,
+        async_dispatch: bool = False,
+        min_wave_ops: int = 0,
     ):
         self.max_docs = max_docs
         self.max_slots = max_slots
         self.K = ops_per_dispatch
+        # overflow flags live on-device; reading them is a host sync that
+        # stalls the whole dispatch pipeline (very expensive over a
+        # tunneled device), so flush() only polls every N dispatches.
+        # Deferral is safe: the flag is sticky (ops/apply.py ORs into it)
+        # and escalation replays the doc from its authoritative log, so
+        # late detection loses nothing. Queries and finalize() always
+        # check before exposing state.
+        self.overflow_check_every = overflow_check_every
+        self._dispatches_since_check = 0
         self.placement = DocPlacement(n_shards=1, slots_per_shard=max_docs)
         self.state: DocState = jax.vmap(lambda _: DocState.empty(max_slots))(
             jnp.arange(max_docs)
@@ -101,7 +132,9 @@ class TpuDocumentApplier:
         # (the round-1 truncated-hash scheme could merge two clients'
         # own-op visibility at the 24-bit birthday bound)
         self._client_ids: dict[int, dict[str, int]] = {}
-        self._staged: dict[int, list[np.ndarray]] = {}
+        # staged device ops as 12-tuples in ops/apply field order; one
+        # np.array() per slot per flush instead of one per op
+        self._staged: dict[int, list[tuple]] = {}
         self._host_docs: dict[int, MergeTreeClient] = {}  # escalated docs
         self._doc_keys: dict[int, tuple[str, str]] = {}
         self._mesh = mesh
@@ -112,9 +145,41 @@ class TpuDocumentApplier:
             self._step = make_sharded_step(mesh)
         else:
             self._step = jax.jit(self._local_step, donate_argnums=(0,))
+            # dense dispatch: ship only the real ops ([N, F] + indices)
+            # and scatter into the [D, K, F] wave ON DEVICE — host→device
+            # traffic scales with the op count, not D*K capacity (the
+            # padded wave was ≥4x the bytes at partial occupancy, and the
+            # tunnel link is the bottleneck)
+            self._dense_step = _dense_step_for(max_docs, self.K)
         self.dispatches = 0
         self.ops_applied = 0
         self.host_escalations = 0
+        # async mode: a worker thread owns wave building + host→device
+        # transfer + dispatch, so tunnel transfer latency never blocks the
+        # ordering pipeline — the applier becomes a real pipeline stage
+        # the way the reference's scribe/scriptorium are separate
+        # consumers of the sequenced topic. The worker is the ONLY state mutator; the
+        # main thread stages tuples under the lock and escalates at sync
+        # points (worker defers overflow escalation to `_overflow_slots`).
+        self._async = async_dispatch
+        # below this many staged ops the worker holds off dispatching
+        # (unless draining): the K-step scan costs the same whether waves
+        # are full or nearly empty, and each distinct dense-bucket shape
+        # costs a compile — steady waves at one size keep both amortized
+        self._min_wave = min_wave_ops
+        self._draining = False
+        if async_dispatch:
+            import threading
+
+            self._lock = threading.Lock()
+            self._wake = threading.Event()
+            self._idle = threading.Event()
+            self._idle.set()
+            self._stop = False
+            self._overflow_slots: set[int] = set()
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True, name="tpu-applier")
+            self._worker.start()
 
     @staticmethod
     def _local_step(state: DocState, ops: jax.Array):
@@ -147,102 +212,291 @@ class TpuDocumentApplier:
         wire_op: dict,
     ) -> None:
         """Stage one sequenced merge-tree wire op for batched apply."""
-        if isinstance(wire_op, dict) and wire_op.get("type") == "interval":
-            return  # interval metadata: no effect on text content
+        self.ingest_batch(tenant_id, document_id, [(msg, wire_op)])
+
+    def ingest_batch(
+        self,
+        tenant_id: str,
+        document_id: str,
+        pairs: list[tuple[SequencedDocumentMessage, dict]],
+    ) -> None:
+        """Stage a broadcast batch of sequenced wire ops in one call —
+        the deli-tpu marshal's per-boxcar entry point. Staging is plain
+        tuple appends; device encoding happens once per flush."""
         slot = self.slot_of(tenant_id, document_id)
         if slot in self._host_docs:
-            self._apply_host(slot, msg, wire_op)
+            for msg, wire_op in pairs:
+                self._apply_host(slot, msg, wire_op)
             return
-        ops = self._vectorize(slot, msg, op_from_wire(wire_op))
-        if ops is None:
-            self._escalate(slot, msg, wire_op)
+        if self._async:
+            # stage into a local list, then splice under the lock — keeps
+            # the critical section to one append/extend
+            staged = []
         else:
-            self._staged.setdefault(slot, []).extend(ops)
-
-    def _vectorize(self, slot, msg, op) -> Optional[list[np.ndarray]]:
-        if isinstance(op, GroupOp):
-            out = []
-            for sub in op.ops:
-                vecs = self._vectorize(slot, msg, sub)
-                if vecs is None:
-                    return None
-                out.extend(vecs)
-            return out
-        common = dict(
-            seq=msg.sequence_number,
-            ref_seq=msg.reference_sequence_number,
-            client=self._intern_client(slot, msg.client_id),
-            msn=msg.minimum_sequence_number,
-        )
-        if isinstance(op, InsertOp):
-            if op.marker is not None:
-                start = self.arenas[slot].append(MARKER_GLYPH)
-                tlen = 1
-                vecs = [make_op(OP_INSERT, pos=op.pos, text_len=1,
-                                text_start=start, flags=FLAG_MARKER, **common)]
+            staged = self._staged.get(slot)
+            if staged is None:
+                staged = self._staged[slot] = []
+        table = self._client_ids.setdefault(slot, {})
+        arena = self.arenas[slot]
+        for i, (msg, wire_op) in enumerate(pairs):
+            if type(wire_op) is not dict:
+                ok = False
             else:
-                text = op.text or ""
-                start = self.arenas[slot].append(text)
-                tlen = len(text)
-                vecs = [make_op(OP_INSERT, pos=op.pos, text_len=tlen,
-                                text_start=start, **common)]
-            # insert-with-props (oracle attaches props to the new segment):
-            # at the insert's OWN perspective the visible span
-            # [pos, pos+len) is exactly the new slot, so follow-up
-            # annotates stamp precisely it
-            vecs.extend(self._annotate_vecs(op.pos, op.pos + tlen,
-                                            op.props or {}, common))
-            return vecs
-        if isinstance(op, RemoveOp):
-            return [make_op(OP_REMOVE, pos=op.start, end=op.end, **common)]
-        if isinstance(op, AnnotateOp):
-            return self._annotate_vecs(op.start, op.end, op.props, common)
-        return None
+                cid = msg.client_id
+                if cid is None:
+                    client = SYSTEM_CLIENT
+                else:
+                    client = table.get(cid)
+                    if client is None:
+                        client = len(table)
+                        table[cid] = client
+                ok = self._stage_op(
+                    staged, arena, wire_op, msg.sequence_number,
+                    msg.reference_sequence_number, client,
+                    msg.minimum_sequence_number)
+            if not ok:
+                # escalation replays the authoritative log (which already
+                # holds this batch) and discards partial staging
+                self._escalate(slot, msg, wire_op)
+                for msg2, wire_op2 in pairs[i + 1:]:
+                    self._apply_host(slot, msg2, wire_op2)
+                return
+        if self._async and staged:
+            with self._lock:
+                cur = self._staged.get(slot)
+                if cur is None:
+                    self._staged[slot] = staged
+                else:
+                    cur.extend(staged)
 
-    def _annotate_vecs(self, start, end, props: dict, common: dict) -> list:
-        # one device op per key; in-order apply gives per-key LWW
-        return [
-            make_op(
-                OP_ANNOTATE, pos=start, end=end,
-                key=self.prop_table.intern_key(k),
-                val=NO_VAL if v is None else self.prop_table.intern_val(v),
-                **common,
+    def _stage_op(self, staged, arena, w, seq, ref, client, msn) -> bool:
+        """Append a wire op's device tuples (ops/apply field order).
+        Returns False when the kernel does not model the op."""
+        t = w.get("type")
+        if t == 0:  # insert
+            pos = w["pos"]
+            marker = w.get("marker")
+            if marker is not None:
+                start = arena.append(MARKER_GLYPH)
+                tlen = 1
+                staged.append((OP_INSERT, pos, 0, seq, ref, client,
+                               1, start, msn, FLAG_MARKER, 0, 0))
+            else:
+                text = w.get("text") or ""
+                start = arena.append(text)
+                tlen = len(text)
+                staged.append((OP_INSERT, pos, 0, seq, ref, client,
+                               tlen, start, msn, 0, 0, 0))
+            props = w.get("props")
+            if props:
+                # insert-with-props (oracle attaches props to the new
+                # segment): at the insert's OWN perspective the visible
+                # span [pos, pos+len) is exactly the new slot, so
+                # follow-up annotates stamp precisely it
+                self._stage_annotate(
+                    staged, pos, pos + tlen, props, seq, ref, client, msn)
+            return True
+        if t == 1:  # remove
+            staged.append((OP_REMOVE, w["start"], w["end"], seq, ref, client,
+                           0, 0, msn, 0, 0, 0))
+            return True
+        if t == 2:  # annotate
+            self._stage_annotate(staged, w["start"], w["end"], w["props"],
+                                 seq, ref, client, msn)
+            return True
+        if t == 3:  # group: all-or-nothing (partial staging is discarded
+            # by _escalate if a sub-op is unsupported)
+            return all(
+                self._stage_op(staged, arena, sub, seq, ref, client, msn)
+                for sub in w["ops"]
             )
-            for k, v in props.items()
-        ]
+        if t == "interval":
+            return True  # interval metadata: no effect on text content
+        return False
+
+    def _stage_annotate(self, staged, start, end, props, seq, ref, client,
+                        msn) -> None:
+        # one device op per key; in-order apply gives per-key LWW
+        intern_key = self.prop_table.intern_key
+        intern_val = self.prop_table.intern_val
+        for k, v in props.items():
+            staged.append((OP_ANNOTATE, start, end, seq, ref, client, 0, 0,
+                           msn, 0, intern_key(k),
+                           NO_VAL if v is None else intern_val(v)))
 
     # -------------------------------------------------------------- flush
 
     def flush(self) -> int:
-        """Dispatch all staged ops to the device in [D, K] waves."""
+        """Dispatch all staged ops to the device in [D, K] waves.
+
+        In async mode this just wakes the worker (non-blocking); in sync
+        mode it dispatches inline. Either way device execution is only
+        fenced by the periodic overflow poll (every
+        ``overflow_check_every`` dispatches) or by ``finalize()``/queries.
+        """
+        if self._async:
+            self._wake.set()
+            return 0
+        return self._flush_sync()
+
+    def _flush_sync(self) -> int:
         total = 0
         while self._staged:
-            batch = np.zeros((self.max_docs, self.K, OP_FIELDS), np.int32)
-            drained = []
-            for slot, ops in self._staged.items():
-                take = min(len(ops), self.K)
-                batch[slot, :take] = ops[:take]
-                total += take
-                if take == len(ops):
-                    drained.append(slot)
-                else:
-                    self._staged[slot] = ops[take:]
-            for slot in drained:
-                del self._staged[slot]
-            ops_dev = jnp.asarray(batch)
-            if self._mesh is not None:
+            parts = self._take_wave_locked()
+            if self._mesh is None:
+                total += self._dispatch_wave(parts)
+            else:
+                batch = np.zeros(
+                    (self.max_docs, self.K, OP_FIELDS), np.int32)
+                for slot, ops in parts:
+                    batch[slot, :len(ops)] = np.array(ops, np.int32)
+                    total += len(ops)
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 ops_dev = jax.device_put(
-                    ops_dev, NamedSharding(self._mesh, P("docs")))
-            self.state, _ = self._step(self.state, ops_dev)
-            self.dispatches += 1
+                    jnp.asarray(batch), NamedSharding(self._mesh, P("docs")))
+                self.state, _ = self._step(self.state, ops_dev)
+                self.dispatches += 1
+                self._dispatches_since_check += 1
         self.ops_applied += total
-        self._check_overflow()
+        if self._dispatches_since_check >= self.overflow_check_every:
+            self._check_overflow()
         return total
 
+    # ------------------------------------------------------ async worker
+
+    def _take_wave_locked(self):
+        """Pop up to K staged ops per doc (caller holds the lock)."""
+        if not self._staged:
+            return None
+        parts = []
+        drained = []
+        K = self.K
+        for slot, ops in self._staged.items():
+            if len(ops) <= K:
+                parts.append((slot, ops))
+                drained.append(slot)
+            else:
+                parts.append((slot, ops[:K]))
+                self._staged[slot] = ops[K:]
+        for slot in drained:
+            del self._staged[slot]
+        return parts
+
+    @property
+    def _bucket(self) -> int:
+        """Fixed dense-dispatch row count: ONE compiled shape per applier
+        geometry (every distinct shape costs a multi-second XLA compile,
+        and partial-wave tails would otherwise walk a ladder of them)."""
+        cap = 1024
+        target = min(self.max_docs * self.K, 32768)
+        while cap < target:
+            cap *= 2
+        return cap
+
+    def _dispatch_wave(self, parts) -> int:
+        """Build the dense wave arrays and dispatch device steps (chunked
+        by the fixed bucket; chunks touch disjoint docs, so ordering
+        within each doc's wave is preserved)."""
+        n = sum(len(ops) for _, ops in parts)
+        cap = self._bucket
+        total = 0
+        i = 0
+        while i < len(parts):
+            flat = np.zeros((cap, OP_FIELDS), np.int32)
+            doc_idx = np.full(cap, self.max_docs, np.int32)
+            pos_idx = np.zeros(cap, np.int32)
+            at = 0
+            while i < len(parts) and at + len(parts[i][1]) <= cap:
+                slot, ops = parts[i]
+                take = len(ops)
+                flat[at:at + take] = np.array(ops, np.int32)
+                doc_idx[at:at + take] = slot
+                pos_idx[at:at + take] = np.arange(take, dtype=np.int32)
+                at += take
+                i += 1
+            self.state, _ = self._dense_step(
+                self.state, jnp.asarray(flat), jnp.asarray(doc_idx),
+                jnp.asarray(pos_idx))
+            self.dispatches += 1
+            self._dispatches_since_check += 1
+            total += at
+            if at == 0:  # a single doc wave larger than the bucket
+                raise RuntimeError("wave part exceeds dispatch bucket")
+        assert total == n
+        return n
+
+    def _worker_loop(self) -> None:
+        import time as _time
+
+        while True:
+            self._wake.wait()
+            if self._stop:
+                return
+            with self._lock:
+                if not self._draining and self._min_wave and sum(
+                    len(v) for v in self._staged.values()
+                ) < self._min_wave:
+                    parts = None
+                else:
+                    parts = self._take_wave_locked()
+                if parts is None:
+                    self._wake.clear()
+                    self._idle.set()
+                    continue
+                self._idle.clear()
+            n = self._dispatch_wave(parts)
+            with self._lock:
+                self.ops_applied += n
+            if self._dispatches_since_check >= self.overflow_check_every:
+                # poll from the worker (it owns the device stream); defer
+                # the actual escalation replay to the main thread's sync
+                self._dispatches_since_check = 0
+                flags = np.asarray(self.state.overflow)
+                hit = set(int(s) for s in np.nonzero(flags)[0])
+                if hit:
+                    with self._lock:
+                        self._overflow_slots |= hit
+            _time.sleep(0)  # yield to the staging thread
+
+    def close(self) -> None:
+        if self._async:
+            self._stop = True
+            self._wake.set()
+            self._worker.join(timeout=5)
+
+    def finalize(self) -> None:
+        """Flush staged ops and fence the device: after this, every doc's
+        state (or its host escalation) reflects everything ingested."""
+        if self._async:
+            import time as _time
+
+            self._draining = True
+            try:
+                while True:
+                    self._wake.set()
+                    with self._lock:
+                        empty = not self._staged
+                    if empty and self._idle.is_set():
+                        break
+                    _time.sleep(0.0005)
+            finally:
+                self._draining = False
+            with self._lock:
+                pending = sorted(self._overflow_slots)
+                self._overflow_slots.clear()
+            for slot in pending:
+                if slot not in self._host_docs:
+                    self._escalate(slot, None, None)
+            self._check_overflow()
+            return
+        self._flush_sync()
+        if self._dispatches_since_check:
+            self._check_overflow()
+
     def _check_overflow(self) -> None:
-        flags = np.asarray(self.state.overflow)
+        self._dispatches_since_check = 0
+        flags = np.asarray(self.state.overflow)  # host sync point
         for slot in np.nonzero(flags)[0]:
             if int(slot) not in self._host_docs:
                 self._escalate(int(slot), None, None)
@@ -257,10 +511,19 @@ class TpuDocumentApplier:
     def _device_slot(self, slot: int) -> DocState:
         return jax.tree.map(lambda a: np.asarray(a)[slot], self.state)
 
-    def get_text(self, tenant_id: str, document_id: str) -> str:
-        slot = self.slot_of(tenant_id, document_id)
+    def _sync(self, slot: int) -> None:
+        """Flush + overflow-check before exposing a doc's state."""
+        if self._async:
+            self.finalize()
+            return
         if self._staged.get(slot):
             self.flush()
+        if self._dispatches_since_check:
+            self._check_overflow()
+
+    def get_text(self, tenant_id: str, document_id: str) -> str:
+        slot = self.slot_of(tenant_id, document_id)
+        self._sync(slot)
         if slot in self._host_docs:
             return self._host_docs[slot].get_text()
         single = self._device_slot(slot)
@@ -276,8 +539,7 @@ class TpuDocumentApplier:
     def get_tree(self, tenant_id: str, document_id: str) -> "MergeTreeClient":
         """Decode the doc to an oracle tree (summaries / inspection)."""
         slot = self.slot_of(tenant_id, document_id)
-        if self._staged.get(slot):
-            self.flush()
+        self._sync(slot)
         if slot in self._host_docs:
             return self._host_docs[slot]
         tree = decode_state(self._device_slot(slot), self.arenas[slot],
@@ -291,8 +553,7 @@ class TpuDocumentApplier:
         """Properties of the visible character at ``pos`` (final
         perspective) — the annotate-path query surface."""
         slot = self.slot_of(tenant_id, document_id)
-        if self._staged.get(slot):
-            self.flush()
+        self._sync(slot)
         if slot in self._host_docs:
             return self._host_docs[slot].get_properties_at(pos)
         single = self._device_slot(slot)
@@ -325,7 +586,11 @@ class TpuDocumentApplier:
         self.host_escalations += 1
         replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
         self._host_docs[slot] = replica
-        self._staged.pop(slot, None)
+        if self._async:
+            with self._lock:
+                self._staged.pop(slot, None)
+        else:
+            self._staged.pop(slot, None)
         for m in self._replay_log(tenant_id, document_id):
             if m.type == MessageType.OPERATION:
                 replica.apply_msg(m, local=False)

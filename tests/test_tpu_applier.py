@@ -26,7 +26,7 @@ def loader(server):
 def feed_applier(applier, server, tenant, doc):
     for msg in channel_stream(server, tenant, doc, "default", "text"):
         applier.ingest(tenant, doc, msg, msg.contents)
-    applier.flush()
+    applier.finalize()  # flush + overflow fence (escalations observed)
 
 
 def test_applier_matches_client_replicas(server, loader):
