@@ -4,10 +4,11 @@ Three measurements in ONE JSON line (round-1 VERDICT #2: an end-to-end
 number, not a dispatch microbenchmark):
 
 - ``value`` (headline): sequenced ops/sec through the FULL in-process
-  service path — deli ticketing, scriptorium persistence, scribe protocol
-  replica, broadcast fan-out to every connected client, AND the
-  TpuDocumentApplier device batch riding the stream (BASELINE config 4
-  analog; north star 50k ops/s).
+  service path at 1024 docs × 2 clients — boxcar submission, deli's
+  vectorized ticket fast lane, scriptorium persistence, scribe protocol
+  replica, broadcast fan-out to every connected client, AND the async
+  TpuDocumentApplier consuming the stream as packed device waves
+  (BASELINE config 4 analog; north star 50k ops/s).
 - ``kernel_ops_per_sec``: the batched device kernel alone at scale
   (10k-doc scribe-replay role, BASELINE config 5), timed against a real
   host readback — NOT block_until_ready, which the axon tunnel treats as
@@ -70,23 +71,50 @@ def bench_kernel() -> float:
 
 
 def bench_service() -> dict:
-    """Full in-process pipeline with the TPU applier riding the stream."""
+    """Full in-process pipeline with the TPU applier riding the stream.
+
+    BASELINE config 4 scale: 1024 docs × 2 clients, each client's 32-op
+    submissions riding the raw log as one boxcar (deli's vectorized fast
+    lane), the async TpuDocumentApplier consuming the broadcast as a
+    packed-wave device pipeline stage. Median of 3 trials: the shared
+    bench host has bursty CPU contention."""
+    import gc
+
     from fluidframework_tpu.service.load_gen import run_inproc
     from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
 
     # compile warm-up on a THROWAWAY applier: reusing it would leave
     # warm-up doc state in the placement slots the measured docs hash to
     # (same names, fresh server, seqs restarting at 1)
-    warm = TpuDocumentApplier(max_docs=128, max_slots=256, ops_per_dispatch=32)
-    run_inproc(n_docs=8, clients_per_doc=2, ops_per_client=5,
-               applier=warm, seed=99)
-    applier = TpuDocumentApplier(max_docs=128, max_slots=256,
-                                 ops_per_dispatch=32)
-    stats = run_inproc(n_docs=64, clients_per_doc=2, ops_per_client=40,
-                       applier=applier, flush_every=2048, seed=1)
-    assert stats.applier_escalations == 0
-    assert stats.ops_acked == stats.ops_submitted
-    return stats.summary()
+    warm = TpuDocumentApplier(max_docs=1024, max_slots=256,
+                              ops_per_dispatch=32)
+    run_inproc(n_docs=8, clients_per_doc=2, ops_per_client=8,
+               applier=warm, seed=99, batch_size=8)
+    warm.close()
+    # steady-state GC posture for an allocation-heavy long-lived service
+    # process (every op materializes message objects): park the warm heap
+    # in the frozen generation and raise the gen0 threshold so collector
+    # walks don't interrupt the hot loop. Without this, mid-run gen2
+    # collections scanning the live scriptorium logs cost 2x the headline.
+    gc.set_threshold(200000, 50, 50)
+    trials = []
+    for t in range(3):
+        gc.collect()
+        gc.freeze()
+        applier = TpuDocumentApplier(
+            max_docs=1024, max_slots=256, ops_per_dispatch=32,
+            async_dispatch=True, min_wave_ops=32768)
+        stats = run_inproc(n_docs=1024, clients_per_doc=2, ops_per_client=48,
+                           applier=applier, flush_every=4096, seed=1 + t,
+                           batch_size=16)
+        applier.close()
+        gc.unfreeze()
+        assert stats.applier_escalations == 0
+        assert stats.ops_acked == stats.ops_submitted
+        assert stats.applier_ops == stats.ops_submitted
+        trials.append(stats.summary())
+    trials.sort(key=lambda s: s["ops_per_sec"])
+    return trials[1]
 
 
 def bench_network() -> dict:

@@ -53,7 +53,7 @@ class NackErrorType(str, Enum):
     LIMIT_EXCEEDED = "LimitExceededError"
 
 
-@dataclass
+@dataclass(slots=True)
 class TraceHop:
     """One service hop stamped onto a message for wire-level latency tracing.
 
@@ -66,7 +66,7 @@ class TraceHop:
     timestamp: float = field(default_factory=lambda: time.time())
 
 
-@dataclass
+@dataclass(slots=True)
 class DocumentMessage:
     """Client → server message (ref: protocol.ts:84-110 IDocumentMessage)."""
 
@@ -78,7 +78,7 @@ class DocumentMessage:
     traces: list[TraceHop] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class SequencedDocumentMessage:
     """Server → client message: an op with its place in the total order.
 
@@ -101,7 +101,7 @@ class SequencedDocumentMessage:
     traces: list[TraceHop] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Nack:
     """Server rejection of a submitted op (ref: protocol.ts:70-82 INack)."""
 
@@ -113,7 +113,7 @@ class Nack:
     retry_after_seconds: Optional[float] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class Signal:
     """Transient, un-sequenced message relayed to all clients.
 

@@ -359,6 +359,10 @@ class DeliLambda:
 
         out = []
         cid = box.client_id
+        # every op in the boxcar tickets at the same instant: ONE hop
+        # object shared across the batch (hops are never mutated, only
+        # copied — consumers that extend traces build their own list)
+        hop = TraceHop(service="deli", action="sequence", timestamp=now)
         for i, op in enumerate(ops):
             ref = op.reference_sequence_number
             if msns is not None:
@@ -367,9 +371,11 @@ class DeliLambda:
                 msn = ref if (others_min is None or ref < others_min) \
                     else others_min
             seq += 1
-            traces = list(op.traces)
-            traces.append(
-                TraceHop(service="deli", action="sequence", timestamp=now))
+            if op.traces:
+                traces = list(op.traces)
+                traces.append(hop)
+            else:
+                traces = [hop]
             out.append(
                 SequencedDocumentMessage(
                     client_id=cid,

@@ -166,3 +166,29 @@ def test_applier_on_virtual_mesh(server, loader):
         feed_applier(applier, server, "t", d)
     for d in docs:
         assert applier.get_text("t", d) == strings[d].get_text()
+
+
+def test_interval_only_batch_does_not_crash_dispatch():
+    """A doc whose batch stages NOTHING on the device (interval metadata
+    ops stage zero tuples) must not break the vectorized wave build when
+    another doc has real ops in the same flush."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedDocumentMessage,
+    )
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=16, ops_per_dispatch=4)
+    applier.set_replay_source(lambda t, d: [])
+
+    def msg(seq):
+        return SequencedDocumentMessage(
+            client_id="c1", sequence_number=seq, minimum_sequence_number=0,
+            client_sequence_number=seq, reference_sequence_number=seq - 1,
+            type=MessageType.OPERATION)
+
+    applier.ingest("t", "iv-doc", msg(1), {"type": "interval", "op": "add"})
+    applier.ingest("t", "txt-doc", msg(1), {"type": 0, "pos": 0, "text": "hi"})
+    applier.flush()
+    applier.finalize()
+    assert applier.host_escalations == 0
+    assert applier.get_text("t", "txt-doc") == "hi"
