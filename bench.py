@@ -118,28 +118,84 @@ def bench_service() -> dict:
 
 
 def bench_network() -> dict:
-    """Socket clients against a live front end: real op-ack latency."""
-    from fluidframework_tpu.service import NetworkFrontEnd
+    """Socket load against a front-end PROCESS: at-load op-ack latency.
+
+    Orchestrator + runner processes (ref: service-load-test
+    nodeStressTest.ts — workers must not share a GIL with the server or
+    each other). Sweeps the submission rate upward until ack p99 crosses
+    the 50 ms north star; reports the highest sustainable load
+    (``max_load_ops_per_sec``) and its p50/p99 — a knee point, not a
+    no-load number."""
+    import subprocess
+    import sys
+
     from fluidframework_tpu.service.load_gen import run_network
 
-    fe = NetworkFrontEnd().start_background()
+    fe = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo",
+    )
     try:
+        line = fe.stdout.readline().strip()
+        assert line.startswith("LISTENING"), line
+        port = int(line.rsplit(":", 1)[1])
         # warm-up: orderer creation, joins, first broadcasts (discarded)
-        run_network(fe.port, n_docs=2, clients_per_doc=2,
-                    ops_per_client=30, seed=7)
-        # median of 3 trials by p99: the shared bench host has bursty
-        # CPU contention that can inflate a single trial by 10-50x
-        trials = []
-        for t in range(3):
-            stats = run_network(fe.port, n_docs=2, clients_per_doc=2,
-                                ops_per_client=300, rate_hz=1000.0,
-                                seed=10 + t)
-            assert stats.ops_acked == stats.ops_submitted
-            trials.append(stats.summary())
-        trials.sort(key=lambda s: s["p99_ack_ms"])
-        return trials[1]
+        run_network(port, n_docs=4, clients_per_doc=2,
+                    ops_per_client=30, seed=7, doc_prefix="warmdoc")
+
+        def trial(rate_hz: float, trial_id: int) -> dict:
+            """4 worker processes × 4 docs × 2 clients = 32 clients."""
+            workers = [
+                subprocess.Popen(
+                    [sys.executable, "-m",
+                     "fluidframework_tpu.service.load_gen",
+                     "--port", str(port), "--docs", "4",
+                     "--clients-per-doc", "2",
+                     "--ops", str(max(80, int(rate_hz))),
+                     "--rate", str(rate_hz),
+                     "--seed", str(100 * trial_id + w),
+                     "--doc-prefix", f"t{trial_id}w{w}d"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, cwd="/root/repo")
+                for w in range(4)
+            ]
+            lats, ops, acked, secs = [], 0, 0, 0.0
+            for w in workers:
+                out, _ = w.communicate(timeout=180)
+                r = json.loads(out)
+                lats.extend(r["lat_ms"])
+                ops += r["ops"]
+                acked += r["acked"]
+                secs = max(secs, r["seconds"])
+            assert acked == ops, (acked, ops)
+            lats.sort()
+            n = len(lats)
+            return {
+                "rate_hz": rate_hz,
+                "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
+                "p50_ack_ms": round(lats[n // 2], 3) if n else 0.0,
+                "p99_ack_ms": round(lats[min(n - 1, int(0.99 * (n - 1)))], 3)
+                if n else 0.0,
+            }
+
+        best = None
+        for i, rate in enumerate((62.5, 125, 187.5, 250)):
+            # median of 3 by p99: bursty CPU contention on the bench host
+            runs = sorted((trial(rate, 10 * i + t) for t in range(3)),
+                          key=lambda r: r["p99_ack_ms"])
+            r = runs[1]
+            if r["p99_ack_ms"] < 50.0:
+                best = r  # sustainable at this load; try the next rung
+            else:
+                if best is None:
+                    best = r  # even the lightest load misses: report it
+                break
+        return best
     finally:
-        fe.stop()
+        fe.terminate()
+        fe.wait(timeout=10)
 
 
 def main() -> None:
@@ -156,7 +212,8 @@ def main() -> None:
                 "unit": "ops/s",
                 "vs_baseline": round(service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
                 "kernel_ops_per_sec": round(kernel_ops, 1),
-                "net_ops_per_sec": net["ops_per_sec"],
+                # at-load socket knee: highest swept load with p99 < 50 ms
+                "net_max_load_ops_per_sec": net["ops_per_sec"],
                 "net_p50_ack_ms": net["p50_ack_ms"],
                 "net_p99_ack_ms": net["p99_ack_ms"],
             }

@@ -36,6 +36,9 @@ class _Transport:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        # op frames are small and latency-bound: Nagle coalescing adds
+        # tens of ms per hop under load
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.timeout = timeout
         self.lock = threading.RLock()  # serializes dispatch vs. submit
         self._wlock = threading.Lock()
@@ -143,14 +146,20 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             "op": None, "nack": None, "signal": None}
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
         self.on_disconnect = None
+        self._disc_fired = False
+
+        def on_ops(f):
+            for d in f["msgs"]:
+                self._deliver("op", message_from_dict(d))
+
+        transport.on_push("ops", on_ops)
         transport.on_push("op", lambda f: self._deliver(
             "op", message_from_dict(f["msg"])))
         transport.on_push("nack", lambda f: self._deliver(
             "nack", message_from_dict(f["nack"])))
         transport.on_push("signal", lambda f: self._deliver(
             "signal", message_from_dict(f["signal"])))
-        transport.on_disconnect = lambda reason: (
-            self.on_disconnect(reason) if self.on_disconnect else None)
+        transport.on_disconnect = self._fire_disconnect
         reply = transport.request({
             "t": "connect", "tenant": tenant_id, "doc": document_id,
             "details": details})
@@ -188,14 +197,24 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})
 
+    def _fire_disconnect(self, reason: str) -> None:
+        """Exactly-once disconnect notification: close() and the reader
+        thread's exit path both land here, and callers should not need to
+        de-register handlers to avoid a double callback."""
+        with self._t._pending_cv:
+            if self._disc_fired:
+                return
+            self._disc_fired = True
+        if self.on_disconnect:
+            self.on_disconnect(reason)
+
     def close(self) -> None:
         try:
             self._t.send({"t": "disconnect"})
         except OSError:
             pass
         self._t.close()
-        if self.on_disconnect:
-            self.on_disconnect("client closed connection")
+        self._fire_disconnect("client closed connection")
 
 
 class NetworkDeltaStorage(DocumentDeltaStorage):

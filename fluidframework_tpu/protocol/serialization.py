@@ -8,7 +8,6 @@ socket/Kafka payloads (protocol-definitions types are the schema).
 from __future__ import annotations
 
 import json
-from dataclasses import asdict
 from typing import Any
 
 from .messages import (
@@ -36,12 +35,69 @@ def register_message_type(kind: str, cls: type, to_dict, from_dict) -> None:
     _CUSTOM[kind] = (cls, to_dict, from_dict)
 
 
+# Hand-rolled encoders: ``dataclasses.asdict`` recursed into (and
+# deep-copied) every ``contents`` payload, and was the front end's
+# second-largest CPU cost under load. Payload dicts are shared by
+# reference — encoders feed json.dumps immediately and nothing mutates
+# wire dicts.
+
+def _hop_dicts(traces) -> list[dict]:
+    return [
+        {"service": t.service, "action": t.action, "timestamp": t.timestamp}
+        for t in traces
+    ]
+
+
+def _doc_fields(m: DocumentMessage) -> dict:
+    return {
+        "client_sequence_number": m.client_sequence_number,
+        "reference_sequence_number": m.reference_sequence_number,
+        "type": m.type,
+        "contents": m.contents,
+        "metadata": m.metadata,
+        "traces": _hop_dicts(m.traces),
+    }
+
+
+_ENCODERS = {
+    DocumentMessage: lambda m: dict(_doc_fields(m), _kind="doc"),
+    SequencedDocumentMessage: lambda m: {
+        "_kind": "seq",
+        "client_id": m.client_id,
+        "sequence_number": m.sequence_number,
+        "minimum_sequence_number": m.minimum_sequence_number,
+        "client_sequence_number": m.client_sequence_number,
+        "reference_sequence_number": m.reference_sequence_number,
+        "type": m.type,
+        "contents": m.contents,
+        "metadata": m.metadata,
+        "origin": m.origin,
+        "timestamp": m.timestamp,
+        "traces": _hop_dicts(m.traces),
+    },
+    Nack: lambda m: {
+        "_kind": "nack",
+        "operation": None if m.operation is None
+        else _doc_fields(m.operation),
+        "sequence_number": m.sequence_number,
+        "code": m.code,
+        "type": m.type,
+        "message": m.message,
+        "retry_after_seconds": m.retry_after_seconds,
+    },
+    Signal: lambda m: {
+        "_kind": "signal",
+        "client_id": m.client_id,
+        "type": m.type,
+        "content": m.content,
+    },
+}
+
+
 def message_to_dict(msg: Any) -> dict:
-    for kind, cls in _KINDS.items():
-        if isinstance(msg, cls):
-            d = asdict(msg)
-            d["_kind"] = kind
-            return d
+    enc = _ENCODERS.get(type(msg))
+    if enc is not None:
+        return enc(msg)
     for kind, (cls, to_dict, _) in _CUSTOM.items():
         if isinstance(msg, cls):
             return dict(to_dict(msg), _kind=kind)

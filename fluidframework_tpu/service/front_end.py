@@ -21,7 +21,7 @@ Frame = 4-byte big-endian length + JSON body {"t": <type>, ...}:
     upload_summary {tenant, doc, summary, parent, rid} → version_id {id, rid}
     disconnect     {}
   server → client (push, after connect)
-    op {msg} | nack {nack} | signal {signal}
+    ops {msgs: [SequencedDocumentMessage…]} | nack {nack} | signal {signal}
   server → client (error reply)
     error {message, rid?}
 
@@ -83,6 +83,8 @@ class _ClientSession:
         self.front = front
         self.writer = writer
         self.conn: Optional[ServerConnection] = None
+        self._dropping = False
+        self._loop = asyncio.get_running_loop()
 
     # -- push events (called synchronously from the pipeline drain, which
     # runs on the loop thread) --
@@ -91,18 +93,62 @@ class _ClientSession:
     # unread socket would otherwise buffer the doc's whole stream in RAM)
     MAX_BUFFERED = 32 * 1024 * 1024
 
+    def _drop_slow_consumer(self) -> None:
+        self.closed()
+        try:
+            self.writer.close()
+        except RuntimeError:
+            pass
+
     def push(self, t: str, payload: dict) -> None:
         try:
             if self.writer.is_closing():
                 return
             transport = self.writer.transport
             if transport.get_write_buffer_size() > self.MAX_BUFFERED:
-                self.closed()
-                self.writer.close()
+                # defer the drop out of the fan-out path: closed() →
+                # disconnect() re-enters the pipeline, and doing that from
+                # inside a broadcast drain only works while drain iterates
+                # a snapshot — schedule it instead of relying on that
+                if not self._dropping:
+                    self._dropping = True
+                    self._loop.call_soon(self._drop_slow_consumer)
                 return
             self.writer.write(_encode_frame({"t": t, **payload}))
         except RuntimeError:
             pass  # transport torn down mid-shutdown; peer is gone anyway
+
+    def _push_op_batch(self, batch: list) -> None:
+        """Encode a broadcast batch ONCE for all its subscribers.
+
+        The broadcaster delivers the same batch object to every session
+        of the doc back to back; a one-entry cache on the front end keyed
+        by (doc, first seq, len) — unique in an append-only stream —
+        turns per-subscriber JSON encoding into a single encode + N raw
+        writes."""
+        conn = self.conn
+        key = (conn.tenant_id, conn.document_id,
+               batch[0].sequence_number, len(batch))
+        cached_key, raw = self.front._batch_cache
+        if cached_key != key:
+            raw = _encode_frame(
+                {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
+            self.front._batch_cache = (key, raw)
+        self.push_raw(raw)
+
+    def push_raw(self, raw: bytes) -> None:
+        try:
+            if self.writer.is_closing():
+                return
+            transport = self.writer.transport
+            if transport.get_write_buffer_size() > self.MAX_BUFFERED:
+                if not self._dropping:
+                    self._dropping = True
+                    self._loop.call_soon(self._drop_slow_consumer)
+                return
+            self.writer.write(raw)
+        except RuntimeError:
+            pass
 
     def handle(self, frame: dict) -> None:
         t = frame.get("t")
@@ -113,8 +159,10 @@ class _ClientSession:
                 conn = server.connect(
                     frame["tenant"], frame["doc"], frame.get("details"))
                 self.conn = conn
-                conn.on_op = lambda m: self.push(
-                    "op", {"msg": message_to_dict(m)})
+                # a broadcast batch rides the wire as ONE frame — at load
+                # the per-op frame overhead (json + syscall each) was the
+                # front end's dominant cost
+                conn.on_ops = self._push_op_batch
                 conn.on_nack = lambda n: self.push(
                     "nack", {"nack": message_to_dict(n)})
                 conn.on_signal = lambda s: self.push(
@@ -217,6 +265,7 @@ class NetworkFrontEnd:
         self.host = host
         self.port = port
         self.max_message_size = max_message_size
+        self._batch_cache: tuple = (None, b"")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
@@ -224,6 +273,12 @@ class NetworkFrontEnd:
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
+        import socket as _socket
+
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            # small latency-bound frames: disable Nagle coalescing
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         session = _ClientSession(self, writer)
         try:
             while True:
@@ -289,12 +344,19 @@ class NetworkFrontEnd:
 
 
 def main() -> None:
+    import gc
+
     parser = argparse.ArgumentParser(description="Fluid TPU network front end")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--max-message-size", type=int,
                         default=DEFAULT_MAX_MESSAGE_SIZE)
     args = parser.parse_args()
+    # steady-state GC posture for a long-lived service process: mid-drain
+    # gen2 collections scanning the scriptorium logs are the largest
+    # latency-spike source under load
+    gc.set_threshold(200000, 50, 50)
+    gc.freeze()
     NetworkFrontEnd(host=args.host, port=args.port,
                     max_message_size=args.max_message_size).serve_forever()
 

@@ -262,6 +262,7 @@ def run_network(
     host: str = "127.0.0.1",
     timeout: float = 60.0,
     rate_hz: Optional[float] = None,
+    doc_prefix: str = "netdoc",
 ) -> LoadStats:
     """Drive socket clients against a live front end; measure op-ack
     latency (submit → own op broadcast back) and throughput.
@@ -283,7 +284,7 @@ def run_network(
     sessions = []
 
     for d in range(n_docs):
-        doc = f"netdoc{d}"
+        doc = f"{doc_prefix}{d}"
         for _ in range(clients_per_doc):
             svc = factory.create_document_service(tenant, doc)
             conn = svc.connect_to_delta_stream()
@@ -327,3 +328,43 @@ def run_network(
     for conn, _, _ in sessions:
         conn.close()
     return stats
+
+
+def _worker_main() -> None:
+    """Subprocess load runner (ref: service-load-test nodeStressTest.ts —
+    the orchestrator spawns N runner PROCESSES so client-side work never
+    shares a GIL with the measurement). Prints one JSON result line."""
+    import argparse
+    import gc
+    import json
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--docs", type=int, default=4)
+    p.add_argument("--clients-per-doc", type=int, default=2)
+    p.add_argument("--ops", type=int, default=200)
+    p.add_argument("--rate", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--doc-prefix", default="netdoc")
+    args = p.parse_args()
+
+    gc.set_threshold(200000, 50, 50)
+    gc.collect()
+    gc.freeze()
+    stats = run_network(
+        args.port, n_docs=args.docs, clients_per_doc=args.clients_per_doc,
+        ops_per_client=args.ops, seed=args.seed, host=args.host,
+        rate_hz=args.rate, doc_prefix=args.doc_prefix)
+    json.dump({
+        "ops": stats.ops_submitted,
+        "acked": stats.ops_acked,
+        "seconds": stats.seconds,
+        "lat_ms": stats.ack_latencies_ms,
+    }, sys.stdout)
+    print()
+
+
+if __name__ == "__main__":
+    _worker_main()
