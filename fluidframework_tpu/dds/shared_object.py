@@ -9,10 +9,12 @@ for their address, with ``local`` telling them it is their own ack.
 
 from __future__ import annotations
 
+import json
 from collections import defaultdict
 from typing import Any, Callable, Optional
 
 from ..protocol.messages import SequencedDocumentMessage
+from ..protocol.summary import SummaryBlob, SummaryHandle, SummaryObject
 
 
 class SharedObject:
@@ -24,6 +26,11 @@ class SharedObject:
         self._is_connected_fn: Callable[[], bool] = lambda: False
         self._listeners: dict[str, list[Callable]] = defaultdict(list)
         self.client_id: Optional[str] = None
+        # seq of the last sequenced op that touched this channel — the
+        # incremental-summary producer compares it against the parent
+        # summary's capture seq to decide handle reuse (ref: summarizer
+        # tracking in summarizerNode / channel contexts)
+        self.last_changed_seq = 0
 
     # ------------------------------------------------------------- wiring
 
@@ -59,6 +66,7 @@ class SharedObject:
     # ----------------------------------------------------------- contract
 
     def process(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        self.last_changed_seq = msg.sequence_number
         self.process_core(msg, local)
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
@@ -87,3 +95,33 @@ class SharedObject:
 
     def load_core(self, snap: dict) -> None:
         raise NotImplementedError
+
+    # ------------------------------------------------------------ summary
+
+    def summarize(self, path: str,
+                  parent_capture_seq: Optional[int] = None) -> SummaryObject:
+        """Incremental summary entry: a channel untouched since the parent
+        summary's capture seq is sent as a HANDLE to the parent's subtree
+        at ``path`` — nothing re-uploads (ref: protocol-definitions
+        summary.ts ISummaryHandle; channel contexts deciding reuse).
+
+        ``last_changed_seq > 0`` guards new channels: one that never saw a
+        sequenced op (attach included) cannot be in the parent tree."""
+        from ..protocol.summary import SummaryTree
+
+        if (
+            parent_capture_seq is not None
+            and 0 < self.last_changed_seq <= parent_capture_seq
+        ):
+            return SummaryHandle(handle=path)
+        return SummaryTree(tree={
+            "type": SummaryBlob(json.dumps(self.channel_type).encode()),
+            "snapshot": self.summarize_core(),
+        })
+
+    def summarize_core(self) -> SummaryObject:
+        """Full (non-handle) summary content. Default: one blob holding
+        the snapshot; DDSes with big state override with a chunked tree
+        (merge-tree, ref snapshotV1.ts:87)."""
+        return SummaryBlob(
+            json.dumps(self.snapshot(), separators=(",", ":")).encode())

@@ -136,7 +136,48 @@ class SharedString(SharedObject):
             },
         }
 
+    # segments per summary chunk blob (ref: SnapshotV1 chunked emit,
+    # snapshotV1.ts:87 — bounded blob sizes keep incremental uploads and
+    # partial loads cheap for giant documents)
+    SUMMARY_CHUNK_SEGMENTS = 256
+
+    def summarize_core(self):
+        import json
+
+        from ..protocol.summary import SummaryBlob, SummaryTree
+
+        snap = self.snapshot()
+        segments = snap["mergetree"]["segments"]
+        n = self.SUMMARY_CHUNK_SEGMENTS
+        if len(segments) <= n:
+            return SummaryBlob(
+                json.dumps(snap, separators=(",", ":")).encode())
+        header = {
+            "mergetree_header": {
+                k: v for k, v in snap["mergetree"].items() if k != "segments"
+            },
+            "intervals": snap["intervals"],
+            "chunks": (len(segments) + n - 1) // n,
+        }
+        tree = {"header": SummaryBlob(
+            json.dumps(header, separators=(",", ":")).encode())}
+        for i in range(header["chunks"]):
+            tree[f"chunk_{i}"] = SummaryBlob(json.dumps(
+                segments[i * n:(i + 1) * n], separators=(",", ":")).encode())
+        return SummaryTree(tree=tree)
+
     def load_core(self, snap: dict) -> None:
+        if "header" in snap and "mergetree" not in snap:
+            # chunked summary form (materialized tree): reassemble
+            header = snap["header"]
+            segments = []
+            for i in range(header["chunks"]):
+                segments.extend(snap[f"chunk_{i}"])
+            snap = {
+                "mergetree": dict(header["mergetree_header"],
+                                  segments=segments),
+                "intervals": header["intervals"],
+            }
         if "mergetree" not in snap:  # pre-intervals snapshot layout
             self.client = MergeTreeClient.load(DETACHED_ID, snap)
             return

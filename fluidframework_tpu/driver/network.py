@@ -253,6 +253,17 @@ class NetworkStorage(DocumentStorage):
         return self._req("write_blob", hex=content.hex())["id"]
 
     def upload_summary(self, summary: Any, parent: Optional[str]) -> str:
+        from ..protocol.summary import (
+            SummaryAttachment,
+            SummaryBlob,
+            SummaryHandle,
+            SummaryTree,
+            summary_to_wire,
+        )
+
+        if isinstance(summary, (SummaryTree, SummaryBlob, SummaryHandle,
+                                SummaryAttachment)):
+            summary = summary_to_wire(summary)
         return self._req("upload_summary", summary=summary, parent=parent)["id"]
 
 

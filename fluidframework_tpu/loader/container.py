@@ -66,7 +66,8 @@ class Container:
             self.protocol = ProtocolOpHandler()
         self.runtime = self._runtime_factory(self)
         if snapshot is not None:
-            self.runtime.load_snapshot(snapshot["runtime"])
+            self.runtime.load_snapshot(snapshot["runtime"],
+                                       base_seq=snapshot["sequence_number"])
         if connect:
             self.connect()
         return self
@@ -135,7 +136,8 @@ class Container:
                 # deterministically off the sequenced leave (SURVEY §2.2)
                 left = (msg.contents or {}).get("clientId")
                 if left:
-                    self.runtime.on_member_removed(left)
+                    self.runtime.on_member_removed(
+                        left, seq=msg.sequence_number)
         for fn in self._message_observers:
             fn(msg)
 

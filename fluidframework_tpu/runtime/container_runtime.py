@@ -122,9 +122,9 @@ class ContainerRuntime:
                 MessageType.OPERATION, envelope
             )
 
-    def on_member_removed(self, client_id: str) -> None:
+    def on_member_removed(self, client_id: str, seq: int = 0) -> None:
         for ds in self.data_stores.values():
-            ds.on_member_removed(client_id)
+            ds.on_member_removed(client_id, seq)
 
     # ----------------------------------------------------------- reconnect
 
@@ -175,8 +175,23 @@ class ContainerRuntime:
             }
         }
 
-    def load_snapshot(self, snap: dict) -> None:
+    def summarize(self, parent_capture_seq=None):
+        """Recursive SummaryTree over stores → channels with handle reuse
+        (ref: ContainerRuntime.summarize containerRuntime.ts:1424). The
+        tree materializes back into exactly ``snapshot()``'s dict shape,
+        so boot needs no incremental-aware path."""
+        from ..protocol.summary import SummaryTree
+
+        return SummaryTree(tree={
+            "dataStores": SummaryTree(tree={
+                ds_id: ds.summarize(
+                    f"runtime/dataStores/{ds_id}", parent_capture_seq)
+                for ds_id, ds in self.data_stores.items()
+            })
+        })
+
+    def load_snapshot(self, snap: dict, base_seq: int = 0) -> None:
         for ds_id, entry in snap.get("dataStores", {}).items():
             ds = DataStoreRuntime(self, ds_id, entry["pkg"])
-            ds.load_snapshot(entry["snapshot"])
+            ds.load_snapshot(entry["snapshot"], base_seq)
             self.data_stores[ds_id] = ds

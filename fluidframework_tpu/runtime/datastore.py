@@ -70,6 +70,9 @@ class DataStoreRuntime:
                 channel = load_channel(attach["type"], channel_id, attach["snapshot"])
                 self._connect_channel(channel)
                 self.channels[channel_id] = channel
+            # stamp on the creator too (the skip branch): a channel born
+            # after the parent summary must never summarize as a handle
+            self.channels[channel_id].last_changed_seq = msg.sequence_number
             return
         channel = self.channels.get(channel_id)
         if channel is None:
@@ -83,10 +86,14 @@ class DataStoreRuntime:
         for channel in self.channels.values():
             channel.set_connection_state(connected, client_id)
 
-    def on_member_removed(self, client_id: str) -> None:
+    def on_member_removed(self, client_id: str, seq: int = 0) -> None:
         for channel in self.channels.values():
             handler = getattr(channel, "on_member_removed", None)
             if handler:
+                # a sequenced leave can mutate the channel (consensus
+                # collections requeue the leaver's holdings) — it must
+                # disqualify handle reuse like any other sequenced change
+                channel.last_changed_seq = max(channel.last_changed_seq, seq)
                 handler(client_id)
 
     # ------------------------------------------------------------ snapshot
@@ -99,8 +106,31 @@ class DataStoreRuntime:
             }
         }
 
-    def load_snapshot(self, snap: dict) -> None:
+    def summarize(self, path: str, parent_capture_seq=None):
+        """Summary subtree mirroring ``snapshot()``'s dict shape, with
+        per-channel handle reuse (ref: FluidDataStoreRuntime summarize →
+        channel contexts)."""
+        import json as _json
+
+        from ..protocol.summary import SummaryBlob, SummaryTree
+
+        return SummaryTree(tree={
+            "pkg": SummaryBlob(_json.dumps(self.pkg).encode()),
+            "snapshot": SummaryTree(tree={
+                "channels": SummaryTree(tree={
+                    cid: ch.summarize(
+                        f"{path}/snapshot/channels/{cid}", parent_capture_seq)
+                    for cid, ch in self.channels.items()
+                })
+            }),
+        })
+
+    def load_snapshot(self, snap: dict, base_seq: int = 0) -> None:
         for cid, entry in snap.get("channels", {}).items():
             channel = load_channel(entry["type"], cid, entry["snapshot"])
             self._connect_channel(channel)
+            # the boot summary captured this channel at base_seq: that is
+            # its change floor, and (being > 0 for any real summary) it
+            # keeps never-touched channels ELIGIBLE for handle reuse
+            channel.last_changed_seq = base_seq
             self.channels[cid] = channel

@@ -35,6 +35,12 @@ class SummaryManager:
         self.container = container
         self.max_ops = max_ops
         self.last_acked_handle: Optional[str] = None
+        # capture seq of the last ACKED summary — the threshold for
+        # per-channel handle reuse. Learned from broadcast SUMMARIZE ops
+        # (anyone's), correlated on ack; None (e.g. storage-seeded head
+        # whose proposal predates us) forces a full upload.
+        self.last_acked_capture_seq: Optional[int] = None
+        self._proposal_heads: dict[str, int] = {}  # handle → capture seq
         self._pending_handle: Optional[str] = None
         self._ops_since_ack = 0
         self.summaries_acked = 0
@@ -68,9 +74,18 @@ class SummaryManager:
     # ------------------------------------------------------------ observer
 
     def _observe(self, msg: SequencedDocumentMessage) -> None:
+        if msg.type == MessageType.SUMMARIZE:
+            # remember every proposal's capture seq so an eventual ack
+            # (ours or another client's) sets the handle-reuse threshold
+            c = msg.contents or {}
+            if c.get("handle") is not None and c.get("head") is not None:
+                self._proposal_heads[c["handle"]] = c["head"]
+            return
         if msg.type == MessageType.SUMMARY_ACK:
             handle = (msg.contents or {}).get("handle")
             self.last_acked_handle = handle
+            self.last_acked_capture_seq = self._proposal_heads.pop(handle, None)
+            self._proposal_heads.clear()  # older proposals can never ack now
             self._ops_since_ack = 0
             if handle == self._pending_handle:
                 self._pending_handle = None
@@ -104,22 +119,34 @@ class SummaryManager:
     # ------------------------------------------------------------- attempt
 
     def summarize_now(self) -> Optional[str]:
-        """Generate, upload, and propose a summary (ref:
+        """Generate, upload, and propose an INCREMENTAL summary (ref:
         ContainerRuntime.generateSummary containerRuntime.ts:1631 +
-        summarize op submission §3.4)."""
+        summarize op submission §3.4): a recursive SummaryTree where
+        channels untouched since the parent's capture seq ride as
+        SummaryHandles and re-upload nothing."""
+        import json
+
+        from ..protocol.summary import SummaryBlob, SummaryTree
+
         if self.container.runtime.pending.count > 0:
             raise RuntimeError("cannot summarize with pending local ops")
-        summary = {
-            "protocol": self.container.protocol.snapshot(),
-            "runtime": self.container.runtime.snapshot(),
-            "sequence_number": self.container.delta_manager.last_processed_seq,
-        }
+        seq = self.container.delta_manager.last_processed_seq
+        cap = (self.last_acked_capture_seq
+               if self.last_acked_handle is not None else None)
+        root = SummaryTree(tree={
+            "protocol": SummaryBlob(json.dumps(
+                self.container.protocol.snapshot(),
+                separators=(",", ":")).encode()),
+            "sequence_number": SummaryBlob(json.dumps(seq).encode()),
+            "runtime": self.container.runtime.summarize(cap),
+        })
         handle = self.container.storage.upload_summary(
-            summary, parent=self.last_acked_handle)
+            root, parent=self.last_acked_handle)
         self._pending_handle = handle
+        self._proposal_heads[handle] = seq
         self.container.delta_manager.submit(
             MessageType.SUMMARIZE,
             {"handle": handle, "parent": self.last_acked_handle,
-             "head": summary["sequence_number"]},
+             "head": seq},
         )
         return handle

@@ -199,6 +199,10 @@ class DeliLambda:
         # fast-lane accounting (bench asserts the hot path stayed hot)
         self.boxcars_fast = 0
         self.boxcars_fallback = 0
+        # clients whose idle-eviction leave is already riding the raw log
+        # (re-emitting every check would bloat the log with duplicates
+        # that replay forever after restarts)
+        self._pending_leaves: set[str] = set()
         self.clients: dict[str, ClientState] = {
             c["client_id"]: ClientState(**c) for c in cp.clients
         }
@@ -248,8 +252,10 @@ class DeliLambda:
             c.client_id
             for c in self.clients.values()
             if c.can_evict and now - c.last_update > self._client_timeout
+            and c.client_id not in self._pending_leaves
         ]:
             if self._send_raw is not None:
+                self._pending_leaves.add(client_id)
                 self._send_raw(
                     RawMessage(
                         tenant_id=self.tenant_id,
@@ -442,6 +448,7 @@ class DeliLambda:
 
         if op.type == MessageType.CLIENT_LEAVE:
             client_id = (op.contents or {}).get("clientId")
+            self._pending_leaves.discard(client_id)
             if client_id not in self.clients:
                 return  # duplicate leave
             self._sequence_system(MessageType.CLIENT_LEAVE, op.contents, now)

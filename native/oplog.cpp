@@ -18,12 +18,25 @@
 #include <mutex>
 #include <string>
 #include <sys/stat.h>
-#ifndef _WIN32
+#ifdef _WIN32
+#include <io.h>
+#else
 #include <unistd.h>
 #endif
 #include <vector>
 
 namespace {
+
+// portable file truncation: recovery MUST be able to cut ragged tails on
+// every platform, or a crash mid-write leaves misaligned index entries
+// that silently corrupt later record ordinals
+int truncate_file(FILE *f, uint64_t size) {
+#ifdef _WIN32
+    return _chsize_s(_fileno(f), (long long)size);
+#else
+    return ftruncate(fileno(f), (off_t)size);
+#endif
+}
 
 struct Topic {
     FILE* data = nullptr;
@@ -71,14 +84,12 @@ Topic* get_topic(OpLog* log, const char* name) {
     fseek(t.index, 0, SEEK_END);
     uint64_t index_bytes = (uint64_t)ftell(t.index);
     if (index_bytes != t.offsets.size() * sizeof(uint64_t)) {
-#ifndef _WIN32
-        if (ftruncate(fileno(t.index),
-                      (off_t)(t.offsets.size() * sizeof(uint64_t))) != 0) {
+        if (truncate_file(t.index,
+                          t.offsets.size() * sizeof(uint64_t)) != 0) {
             fclose(t.data);
             fclose(t.index);
             return nullptr;
         }
-#endif
     }
     fseek(t.data, 0, SEEK_END);
     t.data_end = (uint64_t)ftell(t.data);
@@ -107,14 +118,12 @@ Topic* get_topic(OpLog* log, const char* name) {
         t.offsets.resize(valid);
         fflush(t.index);
         fflush(t.data);
-#ifndef _WIN32
-        if (ftruncate(fileno(t.index), (off_t)(valid * sizeof(uint64_t))) != 0 ||
-            ftruncate(fileno(t.data), (off_t)valid_end) != 0) {
+        if (truncate_file(t.index, valid * sizeof(uint64_t)) != 0 ||
+            truncate_file(t.data, valid_end) != 0) {
             fclose(t.data);
             fclose(t.index);
             return nullptr;
         }
-#endif
         t.data_end = valid_end;
     }
     auto res = log->topics.emplace(name, std::move(t));
@@ -164,9 +173,7 @@ int64_t oplog_append(void* handle, const char* topic, const void* data,
         // roll the data file back to the last valid extent, or the next
         // append would index a record that starts inside garbage bytes
         fflush(t->data);
-#ifndef _WIN32
-        ftruncate(fileno(t->data), (off_t)t->data_end);
-#endif
+        truncate_file(t->data, t->data_end);  // portable rollback
         fseek(t->data, 0, SEEK_END);
         return -1;
     }

@@ -1,0 +1,187 @@
+"""Incremental-summary producer: recursive SummaryTree emit with
+per-channel handle reuse, chunked merge-tree snapshots, byte reduction
+vs full upload, and boot from the incremental chain (local + network).
+
+Ref: ContainerRuntime.summarize (containerRuntime.ts:1424), per-channel
+reuse decisions (channel contexts), ISummaryHandle (protocol-definitions
+summary.ts), chunked emit (merge-tree snapshotV1.ts:87).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime.summarizer import SummaryManager
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def boot_with_channels(loader):
+    c1 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    kv = ds.create_channel("kv", "shared-map")
+    text.insert_text(0, "hello world")
+    kv.set("a", 1)
+    return c1, text, kv
+
+
+def test_second_summary_reuses_unchanged_channels(server, loader):
+    c1, text, kv = boot_with_channels(loader)
+    sm = SummaryManager(c1, max_ops=10**9)  # manual attempts only
+    h1 = sm.summarize_now()
+    assert sm.summaries_acked == 1 and sm.last_acked_handle == h1
+    assert server.storage_stats["handles_reused"] == 0  # first is full
+
+    # touch ONLY the text channel; the map must ride as a handle
+    text.insert_text(0, ">> ")
+    blobs_before = server.storage_stats["blobs_written"]
+    h2 = sm.summarize_now()
+    assert sm.summaries_acked == 2 and sm.last_acked_handle == h2
+    assert server.storage_stats["handles_reused"] >= 1
+
+    # third cycle with NOTHING changed: every channel is a handle
+    reused_before = server.storage_stats["handles_reused"]
+    sm.summarize_now()
+    assert sm.summaries_acked == 3
+    assert server.storage_stats["handles_reused"] >= reused_before + 2
+
+    # a fresh client boots from the incremental chain
+    c2 = loader.resolve("t", "doc")
+    ds2 = c2.runtime.get_data_store("default")
+    assert ds2.get_channel("text").get_text() == ">> hello world"
+    assert ds2.get_channel("kv").get("a") == 1
+    assert blobs_before > 0
+
+
+def test_incremental_upload_writes_fewer_bytes(server, loader):
+    """The incremental upload's new-blob bytes must be well under the
+    full-tree bytes when only one small channel changed."""
+    c1 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    kv = ds.create_channel("kv", "shared-map")
+    for i in range(40):
+        text.insert_text(0, f"paragraph {i} of substantial content. ")
+    sm = SummaryManager(c1, max_ops=10**9)
+
+    before = server.storage_stats["blobs_written"]
+    sm.summarize_now()
+    full_blobs = server.storage_stats["blobs_written"] - before
+
+    kv.set("tiny", 1)  # only the map changes
+    before = server.storage_stats["blobs_written"]
+    sm.summarize_now()
+    incr_blobs = server.storage_stats["blobs_written"] - before
+    # the big text channel (multiple chunk blobs) was NOT re-uploaded
+    assert incr_blobs < full_blobs
+
+
+def test_chunked_mergetree_summary_round_trips(server, loader):
+    """A string with > SUMMARY_CHUNK_SEGMENTS segments emits a chunked
+    subtree, and a fresh client reassembles it correctly."""
+    c1 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    text.SUMMARY_CHUNK_SEGMENTS = 8  # force chunking at test scale
+    for i in range(30):
+        text.insert_text(len(text.get_text()) // 2, f"[{i}]")
+    text.annotate_range(0, 5, {"bold": True})
+    sm = SummaryManager(c1, max_ops=10**9)
+    before = server.storage_stats["blobs_written"]
+    sm.summarize_now()
+    # header + several chunks, not one monolith
+    assert server.storage_stats["blobs_written"] - before > 3
+
+    c2 = loader.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == text.get_text()
+    assert s2.client.get_properties_at(0).get("bold") is True
+    # and the loaded replica stays live
+    s2.insert_text(0, "x")
+    assert text.get_text() == s2.get_text()
+
+
+def test_incremental_chain_over_network_driver():
+    """Summaries upload as wire-encoded trees through the TCP storage RPC
+    and a fresh network client boots from the chain."""
+    import subprocess
+    import sys
+
+    from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo",
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        port = int(line.rsplit(":", 1)[1])
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c1 = loader.resolve("t", "netsumdoc")
+        ds = c1.runtime.create_data_store("default")
+        text = ds.create_channel("text", "shared-string")
+        kv = ds.create_channel("kv", "shared-map")
+        text.insert_text(0, "over the wire")
+        kv.set("k", "v")
+
+        import time
+
+        def wait_for(cond, timeout=10.0):
+            t0 = time.time()
+            while time.time() - t0 < timeout:
+                if cond():
+                    return True
+                time.sleep(0.02)
+            return False
+
+        sm = SummaryManager(c1, max_ops=10**9)
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        sm.summarize_now()
+        assert wait_for(lambda: sm.summaries_acked == 1)
+        text.insert_text(0, "!! ")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        sm.summarize_now()  # kv rides as a handle through the wire codec
+        assert wait_for(lambda: sm.summaries_acked == 2)
+
+        c2 = loader.resolve("t", "netsumdoc")
+        ds2 = c2.runtime.get_data_store("default")
+        assert ds2.get_channel("text").get_text() == "!! over the wire"
+        assert ds2.get_channel("kv").get("k") == "v"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_handle_reuse_survives_summarizer_restart(server, loader):
+    """A summarizer that BOOTED from a summary (cold channels, no op
+    traffic) must still reach handle reuse once its own first summary is
+    acked — loaded channels carry the boot snapshot's capture seq."""
+    c1, text, kv = boot_with_channels(loader)
+    sm1 = SummaryManager(c1, max_ops=10**9)
+    sm1.summarize_now()
+    c1.close()
+
+    c2 = loader.resolve("t", "doc")  # boots from the acked summary
+    assert c2._base_snapshot is not None
+    sm2 = SummaryManager(c2, max_ops=10**9)
+    # first post-boot summary: capture seq of the head is unknown to this
+    # manager, so it uploads full — and gets acked
+    sm2.summarize_now()
+    assert sm2.summaries_acked == 1
+    reused_before = server.storage_stats["handles_reused"]
+    # second summary with nothing touched: every channel rides as handle
+    sm2.summarize_now()
+    assert sm2.summaries_acked == 2
+    assert server.storage_stats["handles_reused"] >= reused_before + 2
