@@ -170,6 +170,14 @@ class DeltaManager:
                 # a previous incarnation's in-flight ops) was processed
                 self._activate_connection()
 
+    def advance_to(self, seq: int) -> int:
+        """Pull and process every sequenced message up to ``seq`` from
+        delta storage WITHOUT a live connection — the replay-driver pump
+        (ref: replay-driver ReplayController stepping the inbound queue).
+        Returns the new last_processed_seq."""
+        self._fetch_missing(upto=seq)
+        return self.last_processed_seq
+
     def _fetch_missing(self, upto: int) -> None:
         """Backfill (last_processed, upto] from delta storage."""
         if upto <= self.last_processed_seq:

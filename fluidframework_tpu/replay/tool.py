@@ -1,0 +1,118 @@
+"""Replay recorded op logs through the REAL client stack and the TPU
+applier, asserting byte-identical state fingerprints across versions.
+
+Ref: replay-tool/src/replayMessages.ts (drives loader+runtime over the
+replay driver, snapshotting at intervals) and
+packages/test/snapshots/src/replayMultipleFiles.ts:33 (Write mode records
+expectations, Compare mode fails on any drift). A fingerprint mismatch
+against a committed corpus means a semantic change to the CRDT — either
+an intentional format bump (re-record the corpus) or a regression.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from ..driver.file import FileDocumentService
+from ..loader.container import Container
+from ..protocol.messages import MessageType
+
+DS_ID = "default"
+TEXT_CHANNEL = "text"
+
+
+def state_fingerprint(container: Container) -> str:
+    """Canonical sha256 over the container's full replica state — the
+    byte-identity the snapshot-regression suite compares across code
+    versions (dict key order normalized; no timestamps included)."""
+    state = {
+        "protocol": container.protocol.snapshot(),
+        "runtime": container.runtime.snapshot(),
+        "sequence_number": container.delta_manager.last_processed_seq,
+    }
+    blob = json.dumps(state, sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+class ReplayController:
+    """Pumps a file-driver document through a real Container in steps."""
+
+    def __init__(self, service: FileDocumentService):
+        self.service = service
+        self.container = Container(service).load(connect=False)
+
+    def run(self, snapshot_every: int = 50) -> dict:
+        """Replay to the end, fingerprinting every ``snapshot_every``
+        sequenced ops; returns the expectations record."""
+        last = self.service.last_seq
+        snapshots: dict[str, str] = {}
+        seq = 0
+        while seq < last:
+            seq = min(seq + snapshot_every, last)
+            at = self.container.delta_manager.advance_to(seq)
+            snapshots[str(at)] = state_fingerprint(self.container)
+        return {
+            "last_seq": last,
+            "snapshots": snapshots,
+            "final_text": self.final_text(),
+        }
+
+    def final_text(self) -> Optional[str]:
+        ds = self.container.runtime.data_stores.get(DS_ID)
+        if ds is None or TEXT_CHANNEL not in ds.channels:
+            return None
+        return ds.get_channel(TEXT_CHANNEL).get_text()
+
+
+def replay_and_compare(doc_dir: str, expect: dict,
+                       snapshot_every: int = 50) -> list[str]:
+    """Compare mode: replay ``doc_dir`` and diff against committed
+    expectations. Returns human-readable mismatches (empty = pass)."""
+    got = ReplayController(
+        FileDocumentService.from_dir(doc_dir)).run(snapshot_every)
+    problems = []
+    if got["last_seq"] != expect["last_seq"]:
+        problems.append(
+            f"last_seq: got {got['last_seq']}, want {expect['last_seq']}")
+    if got["final_text"] != expect["final_text"]:
+        problems.append(
+            f"final_text drift: got {got['final_text']!r}, "
+            f"want {expect['final_text']!r}")
+    for seq, want in expect["snapshots"].items():
+        have = got["snapshots"].get(seq)
+        if have != want:
+            problems.append(f"fingerprint @seq {seq}: {have} != {want}")
+    return problems
+
+
+def replay_through_applier(doc_dir: str, applier=None) -> str:
+    """Feed the recorded doc's text-channel stream through a
+    TpuDocumentApplier (the scribe-replay role, BASELINE config 5) and
+    return the device-side final text."""
+    from ..service.tpu_applier import TpuDocumentApplier
+
+    service = FileDocumentService.from_dir(doc_dir)
+    msgs = service.connect_to_delta_storage().get_deltas(0, 10**9)
+    if applier is None:
+        applier = TpuDocumentApplier(max_docs=4, max_slots=512,
+                                     ops_per_dispatch=16)
+    applier.set_replay_source(lambda t, d: [])
+    pairs = []
+    for m in msgs:
+        if m.type != MessageType.OPERATION:
+            continue
+        env = m.contents
+        if not isinstance(env, dict) or env.get("kind") != "chanop":
+            continue
+        if env["address"] != DS_ID:
+            continue
+        inner = env["contents"]
+        if inner.get("address") != TEXT_CHANNEL or "attach" in inner:
+            continue
+        pairs.append((m, inner["contents"]))
+    applier.ingest_batch("replay", os.path.basename(doc_dir), pairs)
+    applier.finalize()
+    return applier.get_text("replay", os.path.basename(doc_dir))

@@ -1,0 +1,90 @@
+"""Snapshot-regression corpus: committed recorded documents replay
+byte-identically through the real client stack AND converge on the TPU
+applier; any semantic drift in the CRDT fails here.
+
+Ref: packages/test/snapshots/src/replayMultipleFiles.ts:33 (Compare
+mode), packages/tools/replay-tool.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+import fluidframework_tpu.service.tpu_applier as tpu_applier_mod
+from fluidframework_tpu.driver.file import (
+    FileDocumentService,
+    ReadOnlyDocumentError,
+)
+from fluidframework_tpu.replay import (
+    ReplayController,
+    replay_and_compare,
+    replay_through_applier,
+)
+from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus", "corpus")
+SCENARIOS = sorted(os.listdir(CORPUS))
+
+
+def load_expect(name):
+    with open(os.path.join(CORPUS, name, "expect.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("name", SCENARIOS)
+def test_corpus_replays_byte_identical(name):
+    problems = replay_and_compare(
+        os.path.join(CORPUS, name), load_expect(name))
+    assert problems == []
+
+
+@pytest.mark.parametrize("name", SCENARIOS)
+def test_corpus_device_replay_matches(name):
+    """The applier (scribe-replay role) must produce the same text the
+    live replicas converged on when the corpus was recorded."""
+    text = replay_through_applier(os.path.join(CORPUS, name))
+    assert text == load_expect(name)["final_text"]
+
+
+def test_corpus_catches_kernel_change(monkeypatch):
+    """An intentionally-broken kernel must FAIL the corpus comparison —
+    this is the regression tripwire working."""
+    real = tpu_applier_mod.apply_ops_batch
+
+    def skewed(state, wave):
+        # shift every insert one position right: a subtle semantic change
+        pos = wave[..., 1]
+        is_ins = wave[..., 0] == 1
+        wave = wave.at[..., 1].set(jnp.where(is_ins & (pos > 0), pos - 1, pos))
+        return real(state, wave)
+
+    monkeypatch.setattr(tpu_applier_mod, "apply_ops_batch", skewed)
+    # unique geometry → fresh jit trace picks up the patched kernel
+    applier = TpuDocumentApplier(max_docs=3, max_slots=640,
+                                 ops_per_dispatch=13)
+    name = "text-conflict"
+    text = replay_through_applier(os.path.join(CORPUS, name), applier)
+    assert text != load_expect(name)["final_text"]
+
+
+def test_file_driver_boots_from_snapshot_plus_tail():
+    """text-basic carries a mid-stream acked summary: the file driver
+    boots the container from it and the tail replays on top."""
+    doc_dir = os.path.join(CORPUS, "text-basic")
+    assert os.path.exists(os.path.join(doc_dir, "snapshot.json"))
+    svc = FileDocumentService.from_dir(doc_dir)
+    ctl = ReplayController(svc)
+    assert ctl.container.existing  # booted from the snapshot
+    assert ctl.container.delta_manager.last_processed_seq > 0
+    result = ctl.run()
+    assert result["final_text"] == load_expect("text-basic")["final_text"]
+
+
+def test_file_driver_documents_are_read_only():
+    svc = FileDocumentService.from_dir(os.path.join(CORPUS, "text-basic"))
+    with pytest.raises(ReadOnlyDocumentError):
+        svc.connect_to_delta_stream()
+    with pytest.raises(ReadOnlyDocumentError):
+        svc.connect_to_storage().upload_summary({}, None)
