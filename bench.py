@@ -161,7 +161,10 @@ def bench_network() -> dict:
                     text=True, cwd="/root/repo")
                 for w in range(4)
             ]
+            from fluidframework_tpu.utils import TraceAggregator
+
             lats, ops, acked, secs = [], 0, 0, 0.0
+            traces = TraceAggregator()
             for w in workers:
                 out, _ = w.communicate(timeout=180)
                 r = json.loads(out)
@@ -169,15 +172,20 @@ def bench_network() -> dict:
                 ops += r["ops"]
                 acked += r["acked"]
                 secs = max(secs, r["seconds"])
+                traces.merge_raw(r.get("hops", {}))
             assert acked == ops, (acked, ops)
             lats.sort()
             n = len(lats)
+            hop_report = traces.report()
             return {
                 "rate_hz": rate_hz,
                 "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
                 "p50_ack_ms": round(lats[n // 2], 3) if n else 0.0,
                 "p99_ack_ms": round(lats[min(n - 1, int(0.99 * (n - 1)))], 3)
                 if n else 0.0,
+                # per-hop breakdown from the wire traces deli stamps
+                "hops": {name: {"p50_ms": h["p50_ms"], "p99_ms": h["p99_ms"]}
+                         for name, h in hop_report.items()},
             }
 
         best = None
@@ -216,6 +224,7 @@ def main() -> None:
                 "net_max_load_ops_per_sec": net["ops_per_sec"],
                 "net_p50_ack_ms": net["p50_ack_ms"],
                 "net_p99_ack_ms": net["p99_ack_ms"],
+                "net_hops": net.get("hops", {}),
             }
         )
     )

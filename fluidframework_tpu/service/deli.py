@@ -181,12 +181,16 @@ class DeliLambda:
         send_sequenced_batch: Optional[
             Callable[[list[SequencedDocumentMessage]], None]
         ] = None,
+        logger=None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
+        # telemetry on exceptional paths only (nacks, evictions) — the
+        # ticket hot loop stays logging-free
+        self._log = logger
         self._send = send_sequenced
         self._send_batch = send_sequenced_batch
-        self._nack = send_nack
+        self._nack = self._nack_logged(send_nack)
         # deli → raw-topic backchannel (ref: deli sendToAlfred :631) for
         # control messages that must be ticketed deterministically on
         # crash replay (idle-eviction leaves)
@@ -254,6 +258,9 @@ class DeliLambda:
             if c.can_evict and now - c.last_update > self._client_timeout
             and c.client_id not in self._pending_leaves
         ]:
+            if self._log is not None:
+                self._log.info("idle_client_evicted", client_id=client_id,
+                               doc=self.document_id)
             if self._send_raw is not None:
                 self._pending_leaves.add(client_id)
                 self._send_raw(
@@ -277,6 +284,15 @@ class DeliLambda:
 
     def close(self) -> None:
         pass
+
+    def _nack_logged(self, send_nack):
+        def nack(client_id, n):
+            if self._log is not None:
+                self._log.send("error", "nack", client_id=client_id,
+                               doc=self.document_id, code=n.code,
+                               reason=n.message)
+            send_nack(client_id, n)
+        return nack
 
     # ---------------------------------------------------- boxcar fast lane
 

@@ -94,6 +94,9 @@ class _ClientSession:
     MAX_BUFFERED = 32 * 1024 * 1024
 
     def _drop_slow_consumer(self) -> None:
+        self.front.logger.error(
+            "slow_consumer_dropped",
+            client_id=self.conn.client_id if self.conn else None)
         self.closed()
         try:
             self.writer.close()
@@ -216,6 +219,8 @@ class _ClientSession:
             else:
                 raise ValueError(f"unknown frame type {t!r}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
+            self.front.logger.error("frame_error", frame_type=t,
+                                    message=str(e))
             self.push("error", {"rid": rid, "message": str(e)})
 
     def _handle_storage(self, t: str, frame: dict, rid) -> None:
@@ -262,6 +267,7 @@ class NetworkFrontEnd:
                  host: str = "127.0.0.1", port: int = 0,
                  max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE):
         self.server = server if server is not None else LocalServer()
+        self.logger = self.server.logger.child("front_end")
         self.host = host
         self.port = port
         self.max_message_size = max_message_size

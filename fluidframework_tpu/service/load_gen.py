@@ -47,6 +47,8 @@ class LoadStats:
     ack_latencies_ms: list[float] = field(default_factory=list)
     applier_ops: int = 0
     applier_escalations: int = 0
+    # per-hop wire-trace latency (submit→deli, deli→ack), SURVEY §5.1
+    hops: dict = field(default_factory=dict)
 
     @property
     def ops_per_sec(self) -> float:
@@ -272,12 +274,15 @@ def run_network(
     latency (the north-star p99 < 50 ms is an at-load number, not a
     saturation number)."""
     from ..driver.network import NetworkDocumentServiceFactory
+    from ..protocol.messages import TraceHop
+    from ..utils import TraceAggregator
 
     import threading
 
     rng = random.Random(seed)
     factory = NetworkDocumentServiceFactory(host, port)
     stats = LoadStats()
+    traces = TraceAggregator()
     # acks arrive on per-connection reader threads; unsynchronized
     # read-modify-writes on the shared counters would drop increments
     stats_lock = threading.Lock()
@@ -295,10 +300,12 @@ def run_network(
                 if msg.client_id == me:
                     editor.ref_seq = msg.sequence_number
                     sent = pending.pop(msg.client_sequence_number, None)
+                    now = time.time()
                     with stats_lock:
                         if sent is not None:
                             stats.ack_latencies_ms.append(
                                 (time.perf_counter() - sent) * 1e3)
+                        traces.record(msg, ack_time=now)
                         stats.ops_acked += 1
                 else:
                     editor.observe(msg)
@@ -317,6 +324,11 @@ def run_network(
         for conn, editor, pending in sessions:
             with conn.lock:
                 op = editor.next_op()
+                # client-side trace stamp: deli appends its hop, and the
+                # ack observer turns the pair into per-hop latency
+                op.traces.append(
+                    TraceHop(service="client", action="submit",
+                             timestamp=time.time()))
                 pending[op.client_sequence_number] = time.perf_counter()
                 conn.submit([op])
             stats.ops_submitted += 1
@@ -325,6 +337,7 @@ def run_network(
     while stats.ops_acked < expected and time.time() < deadline:
         time.sleep(0.002)
     stats.seconds = time.perf_counter() - t0
+    stats.hops = traces.raw
     for conn, _, _ in sessions:
         conn.close()
     return stats
@@ -362,6 +375,7 @@ def _worker_main() -> None:
         "acked": stats.ops_acked,
         "seconds": stats.seconds,
         "lat_ms": stats.ack_latencies_ms,
+        "hops": stats.hops,
     }, sys.stdout)
     print()
 

@@ -52,6 +52,7 @@ class LocalOrderer:
         pubsub: PubSub,
         clock: Callable[[], float] = time.time,
         client_timeout: Optional[float] = None,
+        logger=None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
@@ -78,6 +79,8 @@ class LocalOrderer:
         kw = {"clock": clock}
         if client_timeout is not None:
             kw["client_timeout"] = client_timeout
+        if logger is not None:
+            kw["logger"] = logger.child("deli")
         self.deli = DeliLambda(
             tenant_id,
             document_id,

@@ -130,7 +130,12 @@ class LocalServer:
         client_timeout: Optional[float] = None,
         log=None,
         storage_dir: Optional[str] = None,
+        logger=None,
     ):
+        from ..utils import TelemetryLogger
+
+        # sink-less by default: zero cost until a host injects a sink
+        self.logger = logger if logger is not None else TelemetryLogger("service")
         # any object with the LocalLog surface works — pass a DurableLog
         # to persist the pipeline across process restarts
         self.log = log if log is not None else LocalLog()
@@ -247,7 +252,7 @@ class LocalServer:
                 kw["client_timeout"] = self._client_timeout
             self._orderers[key] = LocalOrderer(
                 tenant_id, document_id, self.log, self.db, self.pubsub,
-                clock=self._clock, **kw)
+                clock=self._clock, logger=self.logger, **kw)
         return self._orderers[key]
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:

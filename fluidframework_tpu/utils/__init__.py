@@ -1,0 +1,12 @@
+"""Base utils (SURVEY §1.1): telemetry logger, perf events, metrics,
+wire-trace consumption.
+"""
+
+from .telemetry import (  # noqa: F401
+    BufferSink,
+    Counters,
+    PerformanceEvent,
+    TelemetryLogger,
+    TraceAggregator,
+    percentile,
+)

@@ -1,0 +1,179 @@
+"""Telemetry: namespaced logger + perf events + metrics + trace hops.
+
+Ref: packages/utils/telemetry-utils/src/logger.ts — ChildLogger
+namespacing (:239), MultiSinkLogger (:283), PerformanceEvent scoped
+timing (:434); server metric counters (services/src/metricClient.ts:7);
+wire-level trace hops consumed for per-hop latency
+(protocol-definitions/src/protocol.ts:59, deli stamping).
+
+Differences by design: sinks are plain callables (no transport baked
+in), and the trace consumer turns the hops deli already stamps into the
+per-hop latency breakdown the load benches report — the reference
+stamps traces but ships them to an external telegraf; here the
+aggregation is in-process and queryable.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Callable, Optional
+
+Sink = Callable[[dict], None]
+
+
+def percentile(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(p * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class TelemetryLogger:
+    """Namespaced event logger with injectable sinks.
+
+    ``child("deli")`` shares the sink chain and prefixes the namespace —
+    the ChildLogger pattern. Events are dicts with at least
+    ``{"category", "event", "namespace", "ts"}``.
+    """
+
+    def __init__(self, namespace: str = "", sinks: Optional[list[Sink]] = None):
+        self.namespace = namespace
+        self._sinks: list[Sink] = sinks if sinks is not None else []
+
+    def child(self, namespace: str) -> "TelemetryLogger":
+        ns = f"{self.namespace}:{namespace}" if self.namespace else namespace
+        out = TelemetryLogger(ns)
+        out._sinks = self._sinks  # shared chain: adding a sink later
+        return out                # reaches existing children too
+
+    def add_sink(self, sink: Sink) -> None:
+        self._sinks.append(sink)
+
+    def send(self, category: str, event: str, **fields: Any) -> None:
+        if not self._sinks:
+            return
+        record = {"category": category, "event": event,
+                  "namespace": self.namespace, "ts": time.time(), **fields}
+        for sink in self._sinks:
+            sink(record)
+
+    def info(self, event: str, **fields: Any) -> None:
+        self.send("generic", event, **fields)
+
+    def error(self, event: str, **fields: Any) -> None:
+        self.send("error", event, **fields)
+
+    def perf(self, event: str, **fields: Any) -> "PerformanceEvent":
+        return PerformanceEvent(self, event, fields)
+
+
+class BufferSink:
+    """Ring-buffer sink for tests and the /repl-style debug surface."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self.records: list[dict] = []
+
+    def __call__(self, record: dict) -> None:
+        self.records.append(record)
+        if len(self.records) > self.capacity:
+            del self.records[: len(self.records) - self.capacity]
+
+    def of(self, event: str) -> list[dict]:
+        return [r for r in self.records if r["event"] == event]
+
+
+class PerformanceEvent:
+    """Scoped timing (ref: PerformanceEvent logger.ts:434): emits
+    ``<event>_end`` with duration_ms on success, ``<event>_cancel`` with
+    the error on exception."""
+
+    def __init__(self, logger: TelemetryLogger, event: str, fields: dict):
+        self._logger = logger
+        self._event = event
+        self._fields = fields
+        self._t0 = 0.0
+
+    def __enter__(self) -> "PerformanceEvent":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        ms = (time.perf_counter() - self._t0) * 1e3
+        if exc_type is None:
+            self._logger.send("performance", f"{self._event}_end",
+                              duration_ms=ms, **self._fields)
+        else:
+            self._logger.send("performance", f"{self._event}_cancel",
+                              duration_ms=ms, error=str(exc), **self._fields)
+
+
+class Counters:
+    """Named monotonic counters + value observations (metricClient role)."""
+
+    def __init__(self):
+        self._counts: dict[str, int] = defaultdict(int)
+        self._values: dict[str, list[float]] = defaultdict(list)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self._counts[name] += by
+
+    def observe(self, name: str, value: float) -> None:
+        self._values[name].append(value)
+
+    def snapshot(self) -> dict:
+        out: dict[str, Any] = dict(self._counts)
+        for name, vals in self._values.items():
+            s = sorted(vals)
+            out[name] = {
+                "count": len(s),
+                "p50": round(percentile(s, 0.5), 3),
+                "p99": round(percentile(s, 0.99), 3),
+            }
+        return out
+
+
+class TraceAggregator:
+    """Consume wire trace hops into a per-hop latency breakdown.
+
+    The submitting client stamps ``client/submit``; deli stamps
+    ``deli/sequence`` (service/deli.py); the ack observer calls
+    ``record(msg)`` when its own op comes back. Produces the
+    submit→deli and deli→ack split the north-star p99 decomposes into.
+    """
+
+    def __init__(self):
+        self._hops: dict[str, list[float]] = defaultdict(list)
+
+    def record(self, msg, ack_time: Optional[float] = None) -> None:
+        now = ack_time if ack_time is not None else time.time()
+        submit_ts = None
+        deli_ts = None
+        for hop in msg.traces:
+            if hop.service == "client" and hop.action == "submit":
+                submit_ts = hop.timestamp
+            elif hop.service == "deli" and hop.action == "sequence":
+                deli_ts = hop.timestamp
+        if submit_ts is not None and deli_ts is not None:
+            self._hops["submit_to_deli"].append((deli_ts - submit_ts) * 1e3)
+        if deli_ts is not None:
+            self._hops["deli_to_ack"].append((now - deli_ts) * 1e3)
+
+    def merge_raw(self, hops: dict[str, list[float]]) -> None:
+        for name, vals in hops.items():
+            self._hops[name].extend(vals)
+
+    @property
+    def raw(self) -> dict[str, list[float]]:
+        return dict(self._hops)
+
+    def report(self) -> dict:
+        out = {}
+        for name, vals in self._hops.items():
+            s = sorted(vals)
+            out[name] = {"count": len(s),
+                         "p50_ms": round(percentile(s, 0.5), 3),
+                         "p99_ms": round(percentile(s, 0.99), 3)}
+        return out

@@ -1,0 +1,133 @@
+"""Telemetry: logger namespacing/sinks, perf events, counters, and the
+wire-trace consumer producing per-hop latency (SURVEY §5.1/§5.5).
+
+Ref: telemetry-utils/src/logger.ts (ChildLogger :239, PerformanceEvent
+:434), services/src/metricClient.ts, protocol ITrace hops.
+"""
+
+import time
+
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    SequencedDocumentMessage,
+    TraceHop,
+)
+from fluidframework_tpu.utils import (
+    BufferSink,
+    Counters,
+    PerformanceEvent,
+    TelemetryLogger,
+    TraceAggregator,
+)
+
+
+def test_child_logger_namespacing_and_shared_sinks():
+    sink = BufferSink()
+    root = TelemetryLogger("service", sinks=[sink])
+    deli = root.child("deli")
+    deli.info("nack", code=400)
+    root.error("boom")
+    assert sink.records[0]["namespace"] == "service:deli"
+    assert sink.records[0]["code"] == 400
+    assert sink.records[1]["category"] == "error"
+    # a sink added at the root AFTER child creation reaches the child
+    late = BufferSink()
+    root.add_sink(late)
+    deli.info("again")
+    assert late.of("again")
+
+
+def test_sinkless_logger_is_free_and_silent():
+    log = TelemetryLogger("x")
+    log.info("anything", heavy=object())  # must not raise or format
+
+
+def test_performance_event_duration_and_cancel():
+    sink = BufferSink()
+    log = TelemetryLogger("perf", sinks=[sink])
+    with log.perf("step"):
+        time.sleep(0.01)
+    (end,) = sink.of("step_end")
+    assert end["duration_ms"] >= 8
+    try:
+        with log.perf("bad"):
+            raise ValueError("nope")
+    except ValueError:
+        pass
+    (cancel,) = sink.of("bad_cancel")
+    assert "nope" in cancel["error"]
+
+
+def test_counters_snapshot_percentiles():
+    c = Counters()
+    c.inc("ops", 3)
+    for v in range(100):
+        c.observe("lat", float(v))
+    snap = c.snapshot()
+    assert snap["ops"] == 3
+    assert snap["lat"]["count"] == 100
+    assert 45 <= snap["lat"]["p50"] <= 55
+
+
+def _msg(traces):
+    return SequencedDocumentMessage(
+        client_id="c", sequence_number=1, minimum_sequence_number=0,
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, traces=traces)
+
+
+def test_trace_aggregator_per_hop_split():
+    agg = TraceAggregator()
+    t0 = 1000.0
+    agg.record(_msg([
+        TraceHop("client", "submit", t0),
+        TraceHop("deli", "sequence", t0 + 0.004),
+    ]), ack_time=t0 + 0.010)
+    rep = agg.report()
+    assert abs(rep["submit_to_deli"]["p50_ms"] - 4.0) < 0.01
+    assert abs(rep["deli_to_ack"]["p50_ms"] - 6.0) < 0.01
+
+
+def test_deli_stamps_ride_to_clients_and_aggregate():
+    """End-to-end: submit through the real pipeline; the broadcast op
+    carries client+deli hops and the aggregator splits the latency."""
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+    from fluidframework_tpu.service import LocalServer
+
+    server = LocalServer()
+    agg = TraceAggregator()
+    conn = server.connect("t", "doc")
+    acked = []
+    conn.on_ops = lambda batch: [
+        (agg.record(m), acked.append(m))
+        for m in batch if m.client_id == conn.client_id
+    ]
+    conn.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"x": 1},
+        traces=[TraceHop("client", "submit", time.time())])])
+    assert acked
+    rep = agg.report()
+    assert rep["submit_to_deli"]["count"] == 1
+    assert rep["deli_to_ack"]["count"] == 1
+
+
+def test_deli_nacks_and_evictions_are_logged():
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+    from fluidframework_tpu.service import LocalServer
+
+    sink = BufferSink()
+    now = [0.0]
+    server = LocalServer(clock=lambda: now[0], client_timeout=10.0,
+                         logger=TelemetryLogger("svc", sinks=[sink]))
+    conn = server.connect("t", "doc")
+    # clientSeq gap → nack, logged with the reason
+    conn.submit([DocumentMessage(
+        client_sequence_number=5, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={})])
+    (nack,) = sink.of("nack")
+    assert "gap" in nack["reason"] and nack["namespace"].endswith("deli")
+    # idle expiry logged
+    now[0] = 100.0
+    server.expire_idle_clients()
+    assert sink.of("idle_client_evicted")
