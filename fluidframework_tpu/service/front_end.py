@@ -25,6 +25,19 @@ Frame = 4-byte big-endian length + JSON body {"t": <type>, ...}:
   server → client (error reply)
     error {message, rid?}
 
+Gateway backbone (the Redis-pub/sub role — N gateway processes terminate
+client sockets and mux them over ONE upstream connection each; see
+service/gateway.py):
+
+  gateway → core
+    fconnect    {sid, tenant, doc, details, rid} → fconnected {sid, rid, …}
+    fsubmit     {sid, ops} | fsignal {sid, content, type} | fdisconnect {sid}
+    (storage/delta RPCs pass through unchanged — they are stateless)
+  core → gateway
+    fops {topic, msgs}   ONE per broadcast batch per gateway, however many
+                         clients the gateway serves on that doc
+    fnack {sid, nack} | fsignal {topic, signal}
+
 Concurrency model: the ENTIRE service (LocalServer pipeline included) runs
 on the event-loop thread, so no server-side locking is needed — the same
 single-writer discipline the reference gets from Node's event loop.
@@ -85,6 +98,10 @@ class _ClientSession:
         self.conn: Optional[ServerConnection] = None
         self._dropping = False
         self._loop = asyncio.get_running_loop()
+        # gateway-mode state: sid → ServerConnection, and the doc topics
+        # this gateway subscribes (each exactly once)
+        self._fsessions: dict[int, ServerConnection] = {}
+        self._ftopics: dict[str, object] = {}  # topic → pubsub callbacks
 
     # -- push events (called synchronously from the pipeline drain, which
     # runs on the loop thread) --
@@ -216,12 +233,72 @@ class _ClientSession:
             elif t in ("get_versions", "get_tree", "read_blob",
                        "write_blob", "upload_summary"):
                 self._handle_storage(t, frame, rid)
+            elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
+                self._handle_gateway(t, frame, rid)
             else:
                 raise ValueError(f"unknown frame type {t!r}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
             self.front.logger.error("frame_error", frame_type=t,
                                     message=str(e))
             self.push("error", {"rid": rid, "message": str(e)})
+
+    def _handle_gateway(self, t: str, frame: dict, rid) -> None:
+        """Backbone mux for a gateway connection (see module docstring).
+
+        The key property: broadcast fan-out to this gateway is ONE fops
+        frame per batch per doc, not per client — the per-connection
+        subscription server.connect() made is replaced by a per-topic
+        subscription owned by this gateway session."""
+        server = self.front.server
+        if t == "fconnect":
+            sid = frame["sid"]
+            from .broadcaster import BroadcasterLambda
+
+            tenant, doc = frame["tenant"], frame["doc"]
+            topic = BroadcasterLambda.topic(tenant, doc)
+            # the gateway's topic subscription must exist BEFORE the join
+            # is ordered: connect() sequences + broadcasts the join
+            # synchronously, and a lone client that misses its own join
+            # never activates (nothing later triggers gap repair)
+            if topic not in self._ftopics:
+                def on_batch(batch, topic=topic):
+                    self.push("fops", {
+                        "topic": topic,
+                        "msgs": [message_to_dict(m) for m in batch]})
+                server.pubsub.subscribe(topic, on_batch)
+
+                def on_signal(sig, topic=topic):
+                    self.push("fsignal", {
+                        "topic": topic, "signal": message_to_dict(sig)})
+                server.pubsub.subscribe(f"signal/{tenant}/{doc}", on_signal)
+                self._ftopics[topic] = (on_batch, on_signal,
+                                        f"signal/{tenant}/{doc}")
+            conn = server.connect(tenant, doc, frame.get("details"))
+            self._fsessions[sid] = conn
+            # drop the per-connection op/signal subscriptions (the topic
+            # subscription above covers them ONCE per gateway — and their
+            # handler-less buffers would otherwise grow unbounded); nacks
+            # stay per-connection, routed by sid
+            server.pubsub.unsubscribe(topic, conn._op_cb)
+            server.pubsub.unsubscribe(f"signal/{tenant}/{doc}", conn._sig_cb)
+            conn.on_nack = lambda n, sid=sid: self.push(
+                "fnack", {"sid": sid, "nack": message_to_dict(n)})
+            self.push("fconnected", {
+                "rid": rid, "sid": sid,
+                "clientId": conn.client_id,
+                "seq": conn.initial_sequence_number,
+                "maxMessageSize": self.front.max_message_size,
+            })
+        elif t == "fsubmit":
+            conn = self._fsessions[frame["sid"]]
+            conn.submit([message_from_dict(d) for d in frame["ops"]])
+        elif t == "fsignal":
+            conn = self._fsessions[frame["sid"]]
+            conn.submit_signal(frame["content"], frame.get("type", "signal"))
+        elif t == "fdisconnect":
+            conn = self._fsessions.pop(frame["sid"], None)
+            if conn is not None:
+                conn.disconnect()
 
     def _handle_storage(self, t: str, frame: dict, rid) -> None:
         from ..driver.local import LocalStorage
@@ -252,6 +329,16 @@ class _ClientSession:
         if self.conn is not None:
             self.conn.disconnect()
             self.conn = None
+        for conn in self._fsessions.values():
+            conn.disconnect()
+        self._fsessions.clear()
+        if self._ftopics:
+            pubsub = self.front.server.pubsub
+            for topic, (on_batch, on_signal, sig_topic) in \
+                    self._ftopics.items():
+                pubsub.unsubscribe(topic, on_batch)
+                pubsub.unsubscribe(sig_topic, on_signal)
+            self._ftopics.clear()
 
 
 class NetworkFrontEnd:

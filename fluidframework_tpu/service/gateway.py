@@ -1,0 +1,237 @@
+"""Gateway: a horizontally-scalable front-end instance.
+
+Ref: the reference runs N Alfred instances behind Redis-backed socket.io
+(services/src/socketIoRedisPublisher.ts) — each Alfred terminates client
+sockets and the pub/sub layer fans sequenced batches to every instance
+once. Here each gateway process serves the standard client wire protocol
+(driver/network.py speaks to it unchanged) and muxes all its sessions
+over ONE upstream backbone connection to the core ordering process
+(front_end.py's f* frames):
+
+    clients ⇄ gateway (this module) ⇄ core NetworkFrontEnd + pipeline
+
+What scales: socket termination, frame parsing, and broadcast fan-out
+encode move to the gateways; the core sends each doc's batch ONCE per
+gateway as raw bytes that the gateway re-frames once and relays to every
+local subscriber. Submit frames pass through without re-encoding the op
+payloads.
+
+Deployment: ``python -m fluidframework_tpu.service.gateway
+--core-host H --core-port P [--port N]``.
+
+When to use it (measured honestly): on a single host the extra hop LOSES
+— the core's one-encode batch cache makes direct fan-out writes cheap,
+so bench.py keeps the direct topology. Gateways are the cross-HOST
+scale-out story: socket termination under TLS/compression, thousands of
+clients per doc, or a core that is NIC-bound — the same conditions that
+motivate the reference's multi-Alfred deployment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import socket as _socket
+from typing import Optional
+
+from .front_end import _encode_frame, _read_frame
+
+
+class _GatewaySession:
+    """One client connection terminated at this gateway."""
+
+    def __init__(self, gw: "Gateway", writer: asyncio.StreamWriter):
+        self.gw = gw
+        self.writer = writer
+        self.sid: Optional[int] = None
+        self.topic: Optional[str] = None
+
+    def push_raw(self, raw: bytes) -> None:
+        try:
+            if not self.writer.is_closing():
+                self.writer.write(raw)
+        except RuntimeError:
+            pass
+
+    def push(self, obj: dict) -> None:
+        self.push_raw(_encode_frame(obj))
+
+    async def handle(self, frame: dict) -> None:
+        t = frame.get("t")
+        gw = self.gw
+        if t == "connect":
+            self.sid = next(gw.sid_counter)
+            self.topic = f"{frame['tenant']}/{frame['doc']}"
+            gw.sessions[self.sid] = self
+            gw.topic_sessions.setdefault(self.topic, set()).add(self)
+            reply = await gw.upstream_request({
+                "t": "fconnect", "sid": self.sid,
+                "tenant": frame["tenant"], "doc": frame["doc"],
+                "details": frame.get("details")})
+            self.push({"t": "connected", "rid": frame.get("rid"),
+                       "clientId": reply["clientId"], "seq": reply["seq"],
+                       "maxMessageSize": reply.get("maxMessageSize")})
+        elif t == "submit":
+            # ops pass through verbatim — no payload re-encode
+            gw.upstream_send({"t": "fsubmit", "sid": self.sid,
+                              "ops": frame["ops"]})
+        elif t == "signal":
+            gw.upstream_send({"t": "fsignal", "sid": self.sid,
+                              "content": frame["content"],
+                              "type": frame.get("type", "signal")})
+        elif t == "disconnect":
+            self.detach()
+        elif t in ("get_deltas", "get_versions", "get_tree", "read_blob",
+                   "write_blob", "upload_summary"):
+            reply = await gw.upstream_request(
+                {k: v for k, v in frame.items() if k != "rid"})
+            reply["rid"] = frame.get("rid")
+            self.push(reply)
+        else:
+            self.push({"t": "error", "rid": frame.get("rid"),
+                       "message": f"unknown frame type {t!r}"})
+
+    def detach(self) -> None:
+        if self.sid is not None:
+            self.gw.sessions.pop(self.sid, None)
+            if self.topic is not None:
+                peers = self.gw.topic_sessions.get(self.topic)
+                if peers is not None:
+                    peers.discard(self)
+            self.gw.upstream_send({"t": "fdisconnect", "sid": self.sid})
+            self.sid = None
+
+
+class Gateway:
+    def __init__(self, core_host: str, core_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.core_host, self.core_port = core_host, core_port
+        self.host, self.port = host, port
+        self.sessions: dict[int, _GatewaySession] = {}
+        self.topic_sessions: dict[str, set[_GatewaySession]] = {}
+        self.sid_counter = itertools.count(1)
+        self._rid_counter = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._up_writer: Optional[asyncio.StreamWriter] = None
+
+    # ----------------------------------------------------------- upstream
+
+    async def _connect_upstream(self) -> None:
+        reader, writer = await asyncio.open_connection(
+            self.core_host, self.core_port)
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._up_writer = writer
+        asyncio.get_running_loop().create_task(self._upstream_loop(reader))
+
+    def upstream_send(self, obj: dict) -> None:
+        self._up_writer.write(_encode_frame(obj))
+
+    async def upstream_request(self, obj: dict) -> dict:
+        rid = next(self._rid_counter)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        self.upstream_send(dict(obj, rid=rid))
+        reply = await fut
+        if reply.get("t") == "error":
+            raise RuntimeError(f"core error: {reply.get('message')}")
+        return reply
+
+    async def _upstream_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                self._dispatch_upstream(frame)
+        finally:
+            # core gone: every client of this gateway is dead too
+            for session in list(self.sessions.values()):
+                try:
+                    session.writer.close()
+                except Exception:
+                    pass
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("core disconnected"))
+
+    def _dispatch_upstream(self, frame: dict) -> None:
+        rid = frame.get("rid")
+        if rid is not None:
+            fut = self._pending.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(frame)
+            return
+        t = frame.get("t")
+        if t == "fops":
+            # ONE re-encode for all local subscribers of the doc
+            raw = _encode_frame({"t": "ops", "msgs": frame["msgs"]})
+            for session in self.topic_sessions.get(frame["topic"], ()):
+                session.push_raw(raw)
+        elif t == "fnack":
+            session = self.sessions.get(frame["sid"])
+            if session is not None:
+                session.push({"t": "nack", "nack": frame["nack"]})
+        elif t == "fsignal":
+            raw = _encode_frame({"t": "signal", "signal": frame["signal"]})
+            for session in self.topic_sessions.get(frame["topic"], ()):
+                session.push_raw(raw)
+
+    # ------------------------------------------------------------- clients
+
+    async def _handle_client(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        session = _GatewaySession(self, writer)
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                await session.handle(frame)
+                await writer.drain()
+        except (ValueError, json.JSONDecodeError):
+            pass
+        finally:
+            session.detach()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _start(self) -> None:
+        await self._connect_upstream()
+        server = await asyncio.start_server(
+            self._handle_client, self.host, self.port)
+        self.port = server.sockets[0].getsockname()[1]
+
+    def serve_forever(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(self._start())
+        print(f"LISTENING {self.host}:{self.port}", flush=True)
+        loop.run_forever()
+
+
+def main() -> None:
+    import gc
+
+    p = argparse.ArgumentParser(description="Fluid TPU gateway front end")
+    p.add_argument("--core-host", default="127.0.0.1")
+    p.add_argument("--core-port", type=int, required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    gc.set_threshold(200000, 50, 50)
+    gc.freeze()
+    Gateway(args.core_host, args.core_port,
+            host=args.host, port=args.port).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

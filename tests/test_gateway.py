@@ -1,0 +1,123 @@
+"""Horizontal front-end scale-out: N gateway processes over one core
+ordering process (the Redis-pub/sub Alfred topology, SURVEY §2.10).
+
+Ref: services/src/socketIoRedisPublisher.ts (cross-instance broadcast),
+lambdas-driver partition rebalance.
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+
+
+def _spawn(args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+def wait_for(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture(scope="module")
+def topology():
+    """One core + two gateways, all separate OS processes."""
+    core, core_port = _spawn(
+        ["fluidframework_tpu.service.front_end", "--port", "0"])
+    gw1, p1 = _spawn(["fluidframework_tpu.service.gateway",
+                      "--core-port", str(core_port)])
+    gw2, p2 = _spawn(["fluidframework_tpu.service.gateway",
+                      "--core-port", str(core_port)])
+    try:
+        yield core_port, p1, p2
+    finally:
+        for proc in (gw1, gw2, core):
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+def test_clients_on_different_gateways_converge(topology):
+    _, p1, p2 = topology
+    l1 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+    l2 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p2))
+    c1 = l1.resolve("t", "gwdoc")
+    c2 = l2.resolve("t", "gwdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "across gateways")
+    assert wait_for(lambda: "default" in c2.runtime.data_stores
+                    and "text" in c2.runtime.get_data_store("default").channels
+                    and c2.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "across gateways")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(0, ">> ")
+    s1.insert_text(len(s1.get_text()), " <<")
+    assert wait_for(
+        lambda: s1.get_text() == s2.get_text() == ">> across gateways <<")
+
+
+def test_gateway_client_and_direct_core_client_interoperate(topology):
+    core_port, p1, _ = topology
+    lg = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+    lc = Loader(NetworkDocumentServiceFactory("127.0.0.1", core_port))
+    c1 = lg.resolve("t", "mixdoc")
+    c2 = lc.resolve("t", "mixdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "kv", "shared-map")
+    s1.set("from", "gateway")
+    assert wait_for(lambda: "default" in c2.runtime.data_stores
+                    and "kv" in c2.runtime.get_data_store("default").channels
+                    and c2.runtime.get_data_store("default")
+                    .get_channel("kv").get("from") == "gateway")
+    c2.runtime.get_data_store("default").get_channel("kv").set("back", "core")
+    assert wait_for(lambda: s1.get("back") == "core")
+
+
+def test_storage_rpcs_pass_through_gateway(topology):
+    _, p1, _ = topology
+    loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+    c1 = loader.resolve("t", "sumdoc")
+    s = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s.insert_text(0, "summarize me")
+
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    sm = SummaryManager(c1, max_ops=10**9)
+    sm.summarize_now()
+    assert wait_for(lambda: sm.summaries_acked == 1)
+
+    # a fresh gateway client boots from the summary written through the
+    # gateway's storage passthrough
+    c2 = loader.resolve("t", "sumdoc")
+    assert c2._base_snapshot is not None
+    assert wait_for(lambda: c2.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "summarize me")
+
+
+def test_signals_relay_across_gateways(topology):
+    _, p1, p2 = topology
+    l1 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+    l2 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p2))
+    c1 = l1.resolve("t", "sigdoc")
+    c2 = l2.resolve("t", "sigdoc")
+    got = []
+    c2.on_signal = lambda sig: got.append(sig.content)
+    c1.submit_signal({"ping": 1})
+    assert wait_for(lambda: got == [{"ping": 1}])
