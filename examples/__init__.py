@@ -1,0 +1,1 @@
+"""Runnable example applications (the reference's examples/ role)."""

@@ -121,3 +121,15 @@ def test_signals_relay_across_gateways(topology):
     c2.on_signal = lambda sig: got.append(sig.content)
     c1.submit_signal({"ping": 1})
     assert wait_for(lambda: got == [{"ping": 1}])
+
+
+def test_shared_text_example_demo_converges():
+    """The runnable developer-surface demo: server + two editor
+    PROCESSES edit concurrently and render identical documents."""
+    out = subprocess.run(
+        [sys.executable, "-m", "examples.shared_text"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED" in out.stdout
+    assert "⟦verify deli ordering claim⟧" in out.stdout  # anchored comment
+    assert "**Welcome**" in out.stdout  # bold annotation rendered
