@@ -1,0 +1,67 @@
+"""Unified configuration registry.
+
+Ref: the reference scatters configuration across nconf JSON layering per
+micro-service (server/routerlicious/config/config.json), ILoaderOptions
+threading (container.ts), and static engineering flags
+(MergeTree.options); SURVEY §5.6 calls for ONE registry. This module is
+it: every tunable the framework reads lives here with its default, and a
+config resolves by layering defaults ← explicit overrides ← environment
+(``FLUID_TPU_<FIELD>``, the env layer of the nconf pattern).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Any, Optional
+
+ENV_PREFIX = "FLUID_TPU_"
+
+
+@dataclass
+class Config:
+    """All framework tunables, server and client, in one place."""
+
+    # ---- service: deli sequencer (ref: deli/lambdaFactory.ts:29-37)
+    client_timeout_s: float = 300.0      # idle-client eviction
+    # ---- service: front door (ref: localDeltaConnectionServer.ts:96)
+    max_message_size: int = 16 * 1024    # per-op cap, larger ops nacked
+    max_buffered_bytes: int = 32 * 1024 * 1024  # slow-consumer drop bound
+    # ---- service: TPU applier geometry (ops/doc_state + tpu_applier)
+    applier_max_docs: int = 256          # device doc slots [D]
+    applier_max_slots: int = 256         # segment slots per doc [S]
+    applier_ops_per_dispatch: int = 32   # wave depth [K]
+    applier_min_wave_ops: int = 0        # async worker dispatch threshold
+    applier_overflow_check_every: int = 64  # dispatches between fences
+    # ---- client: summarizer heuristics (ref: summarizer.ts:232)
+    summary_max_ops: int = 100           # ops since last ack → attempt
+    # ---- DDS: merge-tree snapshot chunking (ref: snapshotV1.ts:87)
+    summary_chunk_segments: int = 256    # segments per summary chunk blob
+    # ---- service: GC posture for long-lived service processes
+    gc_gen0_threshold: int = 200_000
+
+    def with_overrides(self, **overrides: Any) -> "Config":
+        known = {f.name for f in fields(self)}
+        bad = set(overrides) - known
+        if bad:
+            raise KeyError(f"unknown config keys: {sorted(bad)}")
+        merged = {f.name: getattr(self, f.name) for f in fields(self)}
+        merged.update(overrides)
+        return Config(**merged)
+
+    @classmethod
+    def from_env(cls, base: Optional["Config"] = None) -> "Config":
+        """Environment layer: FLUID_TPU_MAX_MESSAGE_SIZE=65536 etc."""
+        base = base or cls()
+        overrides: dict[str, Any] = {}
+        for f in fields(cls):
+            raw = os.environ.get(ENV_PREFIX + f.name.upper())
+            if raw is None:
+                continue
+            typ = type(getattr(base, f.name))
+            overrides[f.name] = typ(raw)
+        return base.with_overrides(**overrides)
+
+
+# process-wide default instance (explicit Config args always win)
+DEFAULT = Config.from_env()

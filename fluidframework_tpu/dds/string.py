@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..config import DEFAULT as _CFG
 from ..mergetree.client import MergeTreeClient
 from ..mergetree.ops import op_to_wire
 from ..mergetree.references import LocalReference, ReferenceType
@@ -21,6 +22,7 @@ from .registry import register_channel_type
 from .shared_object import SharedObject
 
 DETACHED_ID = "detached"
+_SUMMARY_CHUNK_SEGMENTS = _CFG.summary_chunk_segments
 
 
 @register_channel_type
@@ -138,8 +140,9 @@ class SharedString(SharedObject):
 
     # segments per summary chunk blob (ref: SnapshotV1 chunked emit,
     # snapshotV1.ts:87 — bounded blob sizes keep incremental uploads and
-    # partial loads cheap for giant documents)
-    SUMMARY_CHUNK_SEGMENTS = 256
+    # partial loads cheap for giant documents); default from the unified
+    # config registry, overridable per instance
+    SUMMARY_CHUNK_SEGMENTS = _SUMMARY_CHUNK_SEGMENTS
 
     def summarize_core(self):
         import json

@@ -139,7 +139,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     as the in-proc ServerConnection)."""
 
     def __init__(self, transport: _Transport, tenant_id: str,
-                 document_id: str, details: Any = None):
+                 document_id: str, details: Any = None,
+                 token: Optional[str] = None):
         self._t = transport
         self.lock = transport.lock
         self._handlers: dict[str, Optional[Callable]] = {
@@ -162,7 +163,7 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         transport.on_disconnect = self._fire_disconnect
         reply = transport.request({
             "t": "connect", "tenant": tenant_id, "doc": document_id,
-            "details": details})
+            "details": details, "token": token})
         self.client_id = reply["clientId"]
         self.initial_sequence_number = reply["seq"]
         self.max_message_size = reply.get("maxMessageSize")
@@ -274,10 +275,11 @@ class NetworkDocumentService(DocumentService):
     reference's socket + REST split."""
 
     def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, token_provider=None):
         self._host, self._port, self._timeout = host, port, timeout
         self._tenant = tenant_id
         self._doc = document_id
+        self._token_provider = token_provider
         self._rpc: Optional[_Transport] = None
 
     def _rpc_transport(self) -> _Transport:
@@ -287,7 +289,10 @@ class NetworkDocumentService(DocumentService):
 
     def connect_to_delta_stream(self, details: Any = None) -> NetworkDeltaConnection:
         t = _Transport(self._host, self._port, self._timeout)
-        return NetworkDeltaConnection(t, self._tenant, self._doc, details)
+        token = (self._token_provider(self._tenant, self._doc)
+                 if self._token_provider else None)
+        return NetworkDeltaConnection(t, self._tenant, self._doc, details,
+                                      token=token)
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
         return NetworkDeltaStorage(self._rpc_transport(), self._tenant, self._doc)
@@ -297,11 +302,18 @@ class NetworkDocumentService(DocumentService):
 
 
 class NetworkDocumentServiceFactory(DocumentServiceFactory):
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    """``token_provider(tenant, doc) -> str`` supplies the signed JWT the
+    front door validates when tenancy is enforced (ref:
+    routerlicious-driver tokens.ts TokenProvider)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 token_provider=None):
         self._host, self._port, self._timeout = host, port, timeout
+        self._token_provider = token_provider
 
     def create_document_service(
         self, tenant_id: str, document_id: str
     ) -> NetworkDocumentService:
         return NetworkDocumentService(
-            self._host, self._port, tenant_id, document_id, self._timeout)
+            self._host, self._port, tenant_id, document_id, self._timeout,
+            token_provider=self._token_provider)

@@ -17,9 +17,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..config import DEFAULT as _CFG
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 
-DEFAULT_MAX_OPS = 100  # ops since last acked summary that trigger an attempt
+# ops since last acked summary that trigger an attempt (config registry)
+DEFAULT_MAX_OPS = _CFG.summary_max_ops
 
 
 class SummaryManager:
@@ -30,8 +32,9 @@ class SummaryManager:
     def __init__(
         self,
         container,
-        max_ops: int = DEFAULT_MAX_OPS,
+        max_ops: Optional[int] = None,
     ):
+        max_ops = max_ops if max_ops is not None else _CFG.summary_max_ops
         self.container = container
         self.max_ops = max_ops
         self.last_acked_handle: Optional[str] = None

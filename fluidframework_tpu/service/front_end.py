@@ -108,7 +108,9 @@ class _ClientSession:
     # a session whose unread outbound buffer passes this bound is dropped
     # (slow-consumer protection — fan-out writes are not awaited, so an
     # unread socket would otherwise buffer the doc's whole stream in RAM)
-    MAX_BUFFERED = 32 * 1024 * 1024
+    @property
+    def MAX_BUFFERED(self) -> int:
+        return self.front.server.config.max_buffered_bytes
 
     def _drop_slow_consumer(self) -> None:
         self.front.logger.error(
@@ -177,7 +179,8 @@ class _ClientSession:
         try:
             if t == "connect":
                 conn = server.connect(
-                    frame["tenant"], frame["doc"], frame.get("details"))
+                    frame["tenant"], frame["doc"], frame.get("details"),
+                    token=frame.get("token"))
                 self.conn = conn
                 # a broadcast batch rides the wire as ONE frame — at load
                 # the per-op frame overhead (json + syscall each) was the
@@ -273,7 +276,8 @@ class _ClientSession:
                 server.pubsub.subscribe(f"signal/{tenant}/{doc}", on_signal)
                 self._ftopics[topic] = (on_batch, on_signal,
                                         f"signal/{tenant}/{doc}")
-            conn = server.connect(tenant, doc, frame.get("details"))
+            conn = server.connect(tenant, doc, frame.get("details"),
+                                  token=frame.get("token"))
             self._fsessions[sid] = conn
             # drop the per-connection op/signal subscriptions (the topic
             # subscription above covers them ONCE per gateway — and their
@@ -291,7 +295,22 @@ class _ClientSession:
             })
         elif t == "fsubmit":
             conn = self._fsessions[frame["sid"]]
-            conn.submit([message_from_dict(d) for d in frame["ops"]])
+            ops = []
+            for d in frame["ops"]:
+                op = message_from_dict(d)
+                if len(json.dumps(d).encode()) > self.front.max_message_size:
+                    # same 16 KB service limit as the direct door
+                    self.push("fnack", {"sid": frame["sid"],
+                              "nack": message_to_dict(Nack(
+                                  operation=op, sequence_number=-1, code=413,
+                                  type=NackErrorType.BAD_REQUEST,
+                                  message=f"message exceeds "
+                                          f"{self.front.max_message_size}"
+                                          " byte limit"))})
+                else:
+                    ops.append(op)
+            if ops:
+                conn.submit(ops)
         elif t == "fsignal":
             conn = self._fsessions[frame["sid"]]
             conn.submit_signal(frame["content"], frame.get("type", "signal"))
@@ -352,12 +371,16 @@ class NetworkFrontEnd:
 
     def __init__(self, server: Optional[LocalServer] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE):
+                 max_message_size: Optional[int] = None):
         self.server = server if server is not None else LocalServer()
         self.logger = self.server.logger.child("front_end")
         self.host = host
         self.port = port
-        self.max_message_size = max_message_size
+        # service limits come from the unified config registry unless a
+        # caller overrides explicitly
+        self.max_message_size = (
+            max_message_size if max_message_size is not None
+            else self.server.config.max_message_size)
         self._batch_cache: tuple = (None, b"")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -442,15 +465,26 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="Fluid TPU network front end")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
-    parser.add_argument("--max-message-size", type=int,
-                        default=DEFAULT_MAX_MESSAGE_SIZE)
+    parser.add_argument("--max-message-size", type=int, default=None)
+    parser.add_argument("--tenant", action="append", default=[],
+                        metavar="ID:SECRET",
+                        help="register a tenant (token auth enforced)")
     args = parser.parse_args()
+    server = None
+    if args.tenant:
+        from .tenants import TenantManager
+
+        tenants = TenantManager()
+        for spec in args.tenant:
+            tid, _, secret = spec.partition(":")
+            tenants.register(tid, secret)
+        server = LocalServer(tenants=tenants)
     # steady-state GC posture for a long-lived service process: mid-drain
     # gen2 collections scanning the scriptorium logs are the largest
     # latency-spike source under load
     gc.set_threshold(200000, 50, 50)
     gc.freeze()
-    NetworkFrontEnd(host=args.host, port=args.port,
+    NetworkFrontEnd(server=server, host=args.host, port=args.port,
                     max_message_size=args.max_message_size).serve_forever()
 
 

@@ -69,7 +69,8 @@ class _GatewaySession:
             reply = await gw.upstream_request({
                 "t": "fconnect", "sid": self.sid,
                 "tenant": frame["tenant"], "doc": frame["doc"],
-                "details": frame.get("details")})
+                "details": frame.get("details"),
+                "token": frame.get("token")})
             self.push({"t": "connected", "rid": frame.get("rid"),
                        "clientId": reply["clientId"], "seq": reply["seq"],
                        "maxMessageSize": reply.get("maxMessageSize")})

@@ -131,9 +131,18 @@ class LocalServer:
         log=None,
         storage_dir: Optional[str] = None,
         logger=None,
+        config=None,
+        tenants=None,
     ):
+        from ..config import DEFAULT
         from ..utils import TelemetryLogger
 
+        # unified config registry (SURVEY §5.6): explicit args still win
+        self.config = config if config is not None else DEFAULT
+        # tenant registry (riddler role); empty/None = open dev mode
+        self.tenants = tenants
+        if client_timeout is None:
+            client_timeout = self.config.client_timeout_s
         # sink-less by default: zero cost until a host injects a sink
         self.logger = logger if logger is not None else TelemetryLogger("service")
         # any object with the LocalLog surface works — pass a DurableLog
@@ -172,9 +181,15 @@ class LocalServer:
         document_id: str,
         details: Any = None,
         can_evict: bool = True,
+        token: Optional[str] = None,
     ) -> ServerConnection:
         """The connect_document handshake: join the quorum, get a live
-        connection primed at the current sequence number."""
+        connection primed at the current sequence number. With a tenant
+        registry configured, the token is validated riddler-style BEFORE
+        any document state is touched (ref: alfred connect_document →
+        tenantManager.verifyToken)."""
+        if self.tenants is not None:
+            self.tenants.validate(token, tenant_id, document_id)
         orderer = self._get_orderer(tenant_id, document_id)
         client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)

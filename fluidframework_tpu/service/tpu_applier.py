@@ -151,14 +151,27 @@ class TpuDocumentApplier:
 
     def __init__(
         self,
-        max_docs: int = 256,
-        max_slots: int = 256,
-        ops_per_dispatch: int = 16,
+        max_docs: Optional[int] = None,
+        max_slots: Optional[int] = None,
+        ops_per_dispatch: Optional[int] = None,
         mesh=None,
-        overflow_check_every: int = 64,
+        overflow_check_every: Optional[int] = None,
         async_dispatch: bool = False,
-        min_wave_ops: int = 0,
+        min_wave_ops: Optional[int] = None,
     ):
+        from ..config import DEFAULT as _CFG
+
+        # geometry defaults come from the unified config registry
+        max_docs = max_docs if max_docs is not None else _CFG.applier_max_docs
+        max_slots = (max_slots if max_slots is not None
+                     else _CFG.applier_max_slots)
+        ops_per_dispatch = (ops_per_dispatch if ops_per_dispatch is not None
+                            else _CFG.applier_ops_per_dispatch)
+        overflow_check_every = (
+            overflow_check_every if overflow_check_every is not None
+            else _CFG.applier_overflow_check_every)
+        min_wave_ops = (min_wave_ops if min_wave_ops is not None
+                        else _CFG.applier_min_wave_ops)
         self.max_docs = max_docs
         self.max_slots = max_slots
         self.K = ops_per_dispatch
