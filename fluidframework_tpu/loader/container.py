@@ -42,6 +42,10 @@ class Container:
         self._runtime_factory = runtime_factory or (lambda c: ContainerRuntime(c))
         self.existing = False
         self.closed = False
+        self.detached = False
+        # client-side readonly policy (ref: readonly modes,
+        # deltaManager.ts:274): when set, local submission is refused
+        self._force_readonly = False
         self.on_signal: Optional[Callable[[Signal], None]] = None
         self.on_nack: Optional[Callable[[Nack], None]] = None
         self._base_snapshot: Optional[dict] = None
@@ -86,6 +90,26 @@ class Container:
         """Manual reconnect: new connection + pending-op replay
         (ref: auto-reconnect state machine deltaManager.ts:294,444)."""
         return self.delta_manager.reconnect()
+
+    def attach(self) -> str:
+        """Attach a detached container: connect and let the pending-op
+        replay submit the offline-built initial state as the document's
+        first ops (ref: container.ts:510 + runtime attach flow)."""
+        if not self.detached:
+            raise RuntimeError("container is not detached")
+        self.detached = False
+        return self.connect()
+
+    # ------------------------------------------------------------ readonly
+
+    @property
+    def readonly(self) -> bool:
+        return self._force_readonly
+
+    def force_readonly(self, readonly: bool = True) -> None:
+        """Client-side readonly switch: local edits raise while set
+        (ref: forceReadonly / readonly modes deltaManager.ts:274)."""
+        self._force_readonly = readonly
 
     def close(self) -> None:
         self.closed = True
@@ -175,3 +199,15 @@ class Loader:
     ) -> Container:
         service = self._factory.create_document_service(tenant_id, document_id)
         return Container(service, self._runtime_factory).load(connect)
+
+    def create_detached(self, tenant_id: str, document_id: str) -> Container:
+        """A container that lives entirely client-side until ``attach()``
+        (ref: container.ts:510 detached create → attach). Build the
+        initial data stores/channels offline; every edit records as
+        pending state, and attach() replays it through the normal
+        pending-op machinery as the document's first ops."""
+        service = self._factory.create_document_service(tenant_id, document_id)
+        container = Container(service, self._runtime_factory).load(
+            connect=False)
+        container.detached = True
+        return container

@@ -44,6 +44,12 @@ class DeltaManager:
         self.signal_handler: Optional[Callable[[Signal], None]] = None
         self.connection_handler: Optional[Callable[[bool, Optional[str]], None]] = None
         self._details: Any = None
+        # DeltaScheduler role (deltaScheduler.ts:25): long catch-up drains
+        # call this hook every `inbound_slice` messages so a host can
+        # yield/paint/heartbeat between slices of a big backlog
+        self.inbound_yield: Optional[Callable[[int], None]] = None
+        self.inbound_slice = 256
+        self._drained_since_yield = 0
 
     @property
     def connected(self) -> bool:
@@ -133,6 +139,38 @@ class DeltaManager:
         )
         return self._client_seq
 
+    def submit_batch(self, type: MessageType,
+                     contents_list: list) -> list[int]:
+        """Send a flushed batch as ONE submission: consecutive clientSeqs,
+        one shared refSeq, first/last marked with batch metadata (ref:
+        outbound DeltaQueue batch flush, deltaManager.ts:583 + the
+        batchBegin/batchEnd metadata convention). The whole batch rides
+        the raw log as one boxcar, so it is sequenced contiguously."""
+        if self.connection is None:
+            raise RuntimeError("cannot submit while disconnected")
+        msgs = []
+        seqs = []
+        ref = self.last_processed_seq
+        n = len(contents_list)
+        for i, contents in enumerate(contents_list):
+            self._client_seq += 1
+            seqs.append(self._client_seq)
+            metadata = None
+            if n > 1:
+                if i == 0:
+                    metadata = {"batch": True}
+                elif i == n - 1:
+                    metadata = {"batch": False}
+            msgs.append(DocumentMessage(
+                client_sequence_number=self._client_seq,
+                reference_sequence_number=ref,
+                type=type,
+                contents=contents,
+                metadata=metadata,
+            ))
+        self.connection.submit(msgs)
+        return seqs
+
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         if self.connection is None:
             raise RuntimeError("cannot signal while disconnected")
@@ -159,6 +197,11 @@ class DeltaManager:
             self.minimum_sequence_number = msg.minimum_sequence_number
             if self.process_handler:
                 self.process_handler(msg)
+            if self.inbound_yield is not None:
+                self._drained_since_yield += 1
+                if self._drained_since_yield >= self.inbound_slice:
+                    self._drained_since_yield = 0
+                    self.inbound_yield(self.last_processed_seq)
             if (
                 self._pending_connection is not None
                 and msg.type == MessageType.CLIENT_JOIN

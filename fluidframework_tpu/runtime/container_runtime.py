@@ -16,11 +16,21 @@ Envelope format on the wire (contents of a MessageType.OPERATION):
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass, replace
+from enum import Enum
 from typing import Any, Optional
 
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from .datastore import DataStoreRuntime
+
+
+class FlushMode(Enum):
+    """Ref: containerRuntime.ts FlushMode — IMMEDIATE sends every op as
+    its own submission; TURN_BASED coalesces until flush()."""
+
+    IMMEDIATE = 0
+    TURN_BASED = 1
 
 
 @dataclass
@@ -67,6 +77,12 @@ class ContainerRuntime:
         self.pending = PendingStateManager()
         self.connected = False
         self.client_id: Optional[str] = None
+        # op batching (ref: containerRuntime.ts:1207-1271 FlushMode +
+        # orderSequentially): entries held here are already recorded as
+        # pending; flush() ships them as ONE batch submission
+        self.flush_mode = FlushMode.IMMEDIATE
+        self._batch: list[PendingEntry] = []
+        self._order_depth = 0
 
     # --------------------------------------------------------- data stores
 
@@ -115,12 +131,62 @@ class ContainerRuntime:
         DeltaManager + replays via PendingStateManager; state here lives in
         one place). Recording MUST precede the send: with a synchronous
         in-proc service the ack can arrive inside the submit call."""
+        if getattr(self.container, "readonly", False):
+            # the DDS already applied the edit optimistically; a replica
+            # holding a mutation that can never be submitted is corrupt,
+            # so close it (the reference's readonly assert likewise kills
+            # the container) — apps must gate editing on container.readonly
+            self.container.close()
+            raise PermissionError(
+                "container is readonly: local edits are disabled")
         entry = PendingEntry(-1, envelope)
         self.pending.record_entry(entry)
-        if self.connected:
+        if not self.connected:
+            return
+        if self.flush_mode is FlushMode.TURN_BASED or self._order_depth:
+            self._batch.append(entry)
+        else:
             entry.client_seq = self.container.delta_manager.submit(
                 MessageType.OPERATION, envelope
             )
+
+    # ----------------------------------------------------------- batching
+
+    def set_flush_mode(self, mode: FlushMode) -> None:
+        if mode is FlushMode.IMMEDIATE:
+            self.flush()  # pending batch must not straddle the switch
+        self.flush_mode = mode
+
+    def flush(self) -> None:
+        """Ship the accumulated batch as one contiguous submission — one
+        boxcar on the raw log, sequenced without interleaving."""
+        if self._order_depth:
+            return  # orderSequentially flushes at its own close
+        batch, self._batch = self._batch, []
+        if not batch:
+            return
+        seqs = self.container.delta_manager.submit_batch(
+            MessageType.OPERATION, [e.envelope for e in batch])
+        for entry, seq in zip(batch, seqs):
+            entry.client_seq = seq
+
+    @contextlib.contextmanager
+    def order_sequentially(self):
+        """Everything submitted inside runs as ONE atomic batch (ref:
+        orderSequentially containerRuntime.ts:1207). An exception closes
+        the container — partially-applied optimistic local state cannot
+        be rolled back, so the replica must not keep talking."""
+        self._order_depth += 1
+        try:
+            yield
+        except BaseException:
+            self._order_depth -= 1
+            self._batch.clear()
+            self.container.close()
+            raise
+        self._order_depth -= 1
+        if self._order_depth == 0 and self.flush_mode is FlushMode.IMMEDIATE:
+            self.flush()
 
     def on_member_removed(self, client_id: str, seq: int = 0) -> None:
         for ds in self.data_stores.values():
@@ -137,6 +203,9 @@ class ContainerRuntime:
             self._replay_pending()
         else:
             self.client_id = None
+            # unflushed batch entries were never sent; they stay recorded
+            # as pending and regenerate through the reconnect replay
+            self._batch.clear()
             for ds in self.data_stores.values():
                 ds.set_connection_state(connected, None)
 

@@ -187,12 +187,20 @@ class LocalServer:
         connection primed at the current sequence number. With a tenant
         registry configured, the token is validated riddler-style BEFORE
         any document state is touched (ref: alfred connect_document →
-        tenantManager.verifyToken)."""
+        tenantManager.verifyToken). A doc:read-only token gets a READ
+        connection: it may watch the stream, but submits are nacked with
+        InvalidScopeError (ref: readonly connections, tokens.ts scopes)."""
+        can_write = True
         if self.tenants is not None:
-            self.tenants.validate(token, tenant_id, document_id)
+            from .tenants import SCOPE_READ, SCOPE_WRITE
+
+            claims = self.tenants.validate(token, tenant_id, document_id,
+                                           required_scope=SCOPE_READ)
+            can_write = SCOPE_WRITE in claims.get("scopes", [])
         orderer = self._get_orderer(tenant_id, document_id)
         client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
+        conn.can_write = can_write
 
         topic = BroadcasterLambda.topic(tenant_id, document_id)
         conn._op_cb = conn._deliver_ops  # op topics carry batches
@@ -271,6 +279,17 @@ class LocalServer:
         return self._orderers[key]
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:
+        if not getattr(conn, "can_write", True):
+            from ..protocol.messages import Nack, NackErrorType
+
+            for op in messages:
+                self.pubsub.publish(
+                    f"nack/{conn.tenant_id}/{conn.document_id}/"
+                    f"{conn.client_id}",
+                    Nack(operation=op, sequence_number=-1, code=403,
+                         type=NackErrorType.INVALID_SCOPE,
+                         message="token lacks doc:write scope"))
+            return
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)
         now = self._clock()
         # the whole submitted batch rides the raw log as ONE boxcar record

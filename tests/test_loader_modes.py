@@ -1,0 +1,123 @@
+"""Loader parity: detached create -> attach, readonly modes, read-scope
+connections (ref: container.ts:510 attach flow, deltaManager.ts:274
+readonly, tokens.ts scopes).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+from fluidframework_tpu.service.tenants import (
+    SCOPE_READ,
+    TenantManager,
+    sign_token,
+)
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def test_detached_container_builds_offline_then_attaches(server, loader):
+    detached = loader.create_detached("t", "newdoc")
+    assert detached.detached and not detached.connected
+    ds = detached.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    text.insert_text(0, "built offline")
+    text.annotate_range(0, 5, {"bold": True})
+    kv = ds.create_channel("kv", "shared-map")
+    kv.set("made", "detached")
+    # nothing reached the service yet
+    assert server.get_deltas("t", "newdoc", 0, 10**9) == []
+
+    detached.attach()
+    assert detached.connected and not detached.detached
+    assert detached.runtime.pending.count == 0  # initial state acked
+
+    c2 = loader.resolve("t", "newdoc")
+    ds2 = c2.runtime.get_data_store("default")
+    assert ds2.get_channel("text").get_text() == "built offline"
+    assert ds2.get_channel("kv").get("made") == "detached"
+    # and the attached replica stays live
+    ds2.get_channel("text").insert_text(0, ">")
+    assert text.get_text() == ">built offline"
+
+
+def test_attach_on_non_detached_container_refused(loader):
+    c = loader.resolve("t", "doc")
+    with pytest.raises(RuntimeError, match="not detached"):
+        c.attach()
+
+
+def test_force_readonly_blocks_local_edits(server, loader):
+    c = loader.resolve("t", "doc")
+    s = c.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s.insert_text(0, "editable")
+    c.force_readonly()
+    assert c.readonly
+    # the submission is refused and the now-divergent replica closes
+    # (apps gate editing UI on c.readonly — same contract as the
+    # reference's readonly assert, which kills the container)
+    with pytest.raises(PermissionError, match="readonly"):
+        s.insert_text(0, "nope")
+    assert c.closed
+    assert c.runtime.pending.count == 0  # nothing recorded as pending
+    # the service never saw the refused edit: a fresh replica has the
+    # pre-violation content only
+    c2 = loader.resolve("t", "doc")
+    assert (c2.runtime.get_data_store("default").get_channel("text")
+            .get_text() == "editable")
+
+
+def test_readonly_replica_keeps_receiving_remote_ops(server, loader):
+    c = loader.resolve("t", "doc")
+    s = c.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s.insert_text(0, "editable")
+    c.force_readonly()
+    c2 = loader.resolve("t", "doc")
+    c2.runtime.get_data_store("default").get_channel("text") \
+        .insert_text(0, "remote ")
+    assert s.get_text() == "remote editable"  # reads stay live
+    c.force_readonly(False)
+    s.insert_text(0, "again ")
+    assert s.get_text() == "again remote editable"
+
+
+def test_read_scope_connection_watches_but_cannot_write():
+    tm = TenantManager()
+    tm.register("acme", "s3cret")
+    server = LocalServer(tenants=tm)
+    writer = server.connect(
+        "acme", "doc", token=sign_token("acme", "doc", "s3cret"))
+    reader = server.connect(
+        "acme", "doc",
+        token=sign_token("acme", "doc", "s3cret", scopes=(SCOPE_READ,)))
+    seen, nacks = [], []
+    reader.on_ops = lambda batch: seen.extend(batch)
+    reader.on_nack = lambda n: nacks.append(n)
+
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    writer.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"x": 1})])
+    assert any(m.client_id == writer.client_id for m in seen)  # read works
+    reader.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"x": 2})])
+    assert nacks and nacks[0].type.value == "InvalidScopeError"
+    # the nacked op was never sequenced
+    assert all(m.client_id != reader.client_id or m.type.value != "op"
+               for m in server.get_deltas("acme", "doc", 0, 10**9))
