@@ -160,6 +160,33 @@ def _apply_unified(state: DocState, op) -> DocState:
       stamp, later removers recorded as extra remove clients);
     - annotate is LWW per key into per-slot property tables.
     """
+    vis, vlen, cum = _visibility(state, op[F_REFSEQ], op[F_CLIENT])
+    return _apply_core(state, op, vis, vlen, cum, jnp.sum(vlen))
+
+
+def _apply_core(
+    state: DocState,
+    op,
+    vis,
+    vlen,
+    cum,
+    total,
+    insert_here=True,
+    reduce_any=None,
+):
+    """The unified apply body over PRECOMPUTED visibility.
+
+    Single-doc callers pass locally-computed (vis, vlen, cum, total).
+    The segment-sharded giant-doc path (parallel/long_doc.py) passes a
+    GLOBAL prefix (local cum + shard offset, global total), masks the
+    insert to the boundary-owning shard via ``insert_here``, and supplies
+    ``reduce_any`` (a pmax over the 'seg' axis) so a capacity/shape
+    problem on ANY shard aborts the op on EVERY shard — the op either
+    applies everywhere or flags overflow everywhere.
+    """
+    if reduce_any is None:
+        def reduce_any(x):
+            return x
     S = state.max_slots
     typ = op[F_TYPE]
     is_ins = typ == OP_INSERT
@@ -167,11 +194,9 @@ def _apply_unified(state: DocState, op) -> DocState:
     is_ann = typ == OP_ANNOTATE
     active = is_ins | is_rem | is_ann
     pos, end = op[F_POS], op[F_END]
-    seq, ref_seq, client = op[F_SEQ], op[F_REFSEQ], op[F_CLIENT]
+    seq, client = op[F_SEQ], op[F_CLIENT]
     p2 = jnp.where(is_ins, pos, end)
 
-    vis, vlen, cum = _visibility(state, ref_seq, client)  # THE prefix pass
-    total = jnp.sum(vlen)
     bad_shape = jnp.where(is_ins, pos > total, (end > total) | (end <= pos))
     inc = cum + vlen
 
@@ -182,16 +207,17 @@ def _apply_unified(state: DocState, op) -> DocState:
     inside2 = vis & (cum < p2) & (p2 < inc)
     s1_raw = jnp.any(inside1)
     s2_raw = (~is_ins) & jnp.any(inside2)
-    needed = jnp.where(
-        is_ins,
-        1 + s1_raw.astype(jnp.int32),
-        s1_raw.astype(jnp.int32) + s2_raw.astype(jnp.int32),
+    needed = (
+        s1_raw.astype(jnp.int32)
+        + s2_raw.astype(jnp.int32)
+        + (is_ins & insert_here).astype(jnp.int32)
     )
-    bad = active & (bad_shape | (state.count + needed > S))
+    bad = active & reduce_any(bad_shape | (state.count + needed > S))
     ok = active & ~bad
+    insert_ok = ok & insert_here
     s1 = s1_raw & ok
     s2 = s2_raw & ok
-    do_ins = is_ins & ok
+    do_ins = is_ins & insert_ok
 
     j1 = jnp.argmax(inside1)
     j2 = jnp.argmax(inside2)

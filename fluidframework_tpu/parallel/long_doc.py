@@ -18,8 +18,17 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from ..ops.apply import _visibility
+from ..ops.apply import (
+    F_CLIENT,
+    F_POS,
+    F_REFSEQ,
+    _apply_core,
+    _visibility,
+    compact,
+    wave_min_seq,
+)
 from ..ops.doc_state import DocState
 
 
@@ -63,3 +72,89 @@ def sharded_resolve_position(
     winner_slot = jax.lax.pmax(vote, axis)
     winner_off = jax.lax.pmax(jnp.where(has_local, offset, -1), axis)
     return winner_slot, winner_off, (winner_slot >= 0) & (pos < total)
+
+
+def sharded_apply_op(state: DocState, op, axis="seg") -> DocState:
+    """Apply ONE sequenced op to a GIANT doc whose slot arrays are
+    sharded over ``axis`` — the composed segment-parallel apply (the SP
+    analog the doc-sharded step cannot cover when a single document's
+    segment array exceeds one chip).
+
+    Runs inside ``shard_map``; ``state.count`` is the shard's LOCAL used
+    count. Three collectives per op, all scalar-sized over ICI:
+    the prefix all_gather, the insert-owner vote (pmin), and the
+    all-shards abort reduction (pmax) — everything else is the same
+    gather-free local rebuild as the single-chip kernel (_apply_core).
+
+    Insert ownership: the op inserts at the EARLIEST global boundary
+    (same tie-break as unsharded). Shard-local free tails carry
+    cum == their shard's end offset, so the earliest boundary's shard is
+    exactly the pmin over (shard, slot) keys among shards holding any
+    boundary — content boundaries and the append point fall out of one
+    rule.
+    """
+    S = state.length.shape[-1]
+    vis, vlen, cum, total = sharded_visible_prefix(
+        state, op[F_REFSEQ], op[F_CLIENT], state.count, axis)
+    pos = op[F_POS]
+    boundary = cum >= pos
+    has_b = jnp.any(boundary)
+    j0 = jnp.argmax(boundary)
+    my = lax.axis_index(axis)
+    big = jnp.int32(1 << 30)
+    key = jnp.where(has_b, my * S + j0, big)
+    owner_key = -lax.pmax(-key, axis)  # pmin
+    insert_here = has_b & (owner_key == key)
+
+    def reduce_any(x):
+        return lax.pmax(x.astype(jnp.int32), axis) > 0
+
+    return _apply_core(state, op, vis, vlen, cum, total,
+                       insert_here=insert_here, reduce_any=reduce_any)
+
+
+def sharded_apply_ops(state: DocState, ops, axis="seg") -> DocState:
+    """Apply K sequenced ops (int32[K, OP_FIELDS]) to a sharded giant
+    doc, in order, then run zamboni locally at the wave's msn floor
+    (compaction is per-shard: packing never crosses shard boundaries, so
+    global segment order is preserved shard-major)."""
+
+    def step(s, op):
+        return sharded_apply_op(s, op, axis), None
+
+    out, _ = lax.scan(step, state, ops)
+    return compact(out, wave_min_seq(ops))
+
+
+def rebalance_shards(arrays: dict, counts) -> tuple[dict, "jnp.ndarray"]:
+    """Host-side shard rebalancing for a giant doc.
+
+    Mid-doc inserts always land on the shard owning the boundary, so hot
+    spots fill one shard while neighbors sit empty; when a shard nears
+    capacity the host redistributes the logical segment sequence evenly
+    and resumes (the dynamic analog of the reference's B-tree node
+    splits, mergeTree.ts:2509 — rebalancing IS the split, done in bulk).
+
+    ``arrays``: field → np.ndarray[n_shards, S_LOCAL(, P)] in shard-major
+    logical order with per-shard ``counts``. Returns evenly re-packed
+    arrays + new counts. Pure numpy: this runs between device dispatches,
+    like the TpuDocumentApplier's escalation path.
+    """
+    import numpy as np
+
+    n_shards = len(counts)
+    total = int(np.sum(counts))
+    per = -(-total // n_shards)  # ceil: even spread
+    out = {f: np.zeros_like(a) for f, a in arrays.items()}
+    new_counts = np.zeros(n_shards, np.int32)
+    # concatenate live rows in logical order once
+    live = {f: np.concatenate([a[s, : counts[s]] for s in range(n_shards)])
+            for f, a in arrays.items()}
+    at = 0
+    for s in range(n_shards):
+        take = min(per, total - at)
+        for f in out:
+            out[f][s, :take] = live[f][at:at + take]
+        new_counts[s] = take
+        at += take
+    return out, new_counts

@@ -1,0 +1,166 @@
+"""Segment-sharded giant-doc APPLY (SURVEY §5.7 SP analog): the composed
+sharded apply must match the single-chip kernel op-for-op on a fuzzed
+stream — same content, same stamps, same zamboni result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.apply import (
+    apply_ops_scan,
+    compact,
+    wave_min_seq,
+)
+from fluidframework_tpu.ops.doc_state import DocState
+from fluidframework_tpu.ops.opgen import generate_batch_ops
+from fluidframework_tpu.parallel.long_doc import sharded_apply_ops
+from fluidframework_tpu.parallel.mesh import make_mesh
+
+N_SHARDS = 8
+S_LOCAL = 64
+S_GLOBAL = N_SHARDS * S_LOCAL
+
+SLOT_FIELDS = ("length", "text_start", "flags", "ins_seq", "ins_client",
+               "rem_seq", "rem_client_a", "rem_client_b")
+
+
+def _live_rows(arrs, counts):
+    """Ordered logical segment rows (shard-major, used slots only)."""
+    rows = []
+    if np.isscalar(counts) or counts.ndim == 0:
+        counts = np.array([counts])
+        arrs = {k: v[None, :] for k, v in arrs.items()}
+    for s in range(len(counts)):
+        for i in range(int(counts[s])):
+            rows.append(tuple(int(arrs[f][s, i]) for f in SLOT_FIELDS))
+    return rows
+
+
+def _run_pair(seed, n_ops, remove_fraction=0.3, annotate_fraction=0.1):
+    rng = np.random.default_rng(seed)
+    ops = jnp.asarray(generate_batch_ops(
+        rng, 1, n_ops, remove_fraction=remove_fraction,
+        annotate_fraction=annotate_fraction, max_insert=6)[0])
+
+    # --- single-chip reference
+    ref = DocState.empty(S_GLOBAL)
+    ref = jax.tree.map(jnp.asarray, ref)
+    ref = apply_ops_scan(ref, ops)
+    ref = compact(ref, wave_min_seq(ops))
+    assert not bool(ref.overflow), "reference overflowed; enlarge slots"
+
+    # --- sharded: per-shard [S_LOCAL] arrays + per-shard counts
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(N_SHARDS, seg_shards=N_SHARDS)
+    empty = DocState.empty(S_LOCAL)
+    sharded = DocState(
+        **{f: jnp.tile(getattr(empty, f), (N_SHARDS,) +
+                       (1,) * getattr(empty, f).ndim)
+           for f in SLOT_FIELDS + ("prop_key", "prop_val")},
+        count=jnp.zeros(N_SHARDS, jnp.int32),
+        overflow=jnp.zeros(N_SHARDS, bool),
+    )
+    seg = P("seg")
+    specs = DocState(
+        **{f: seg for f in SLOT_FIELDS + ("prop_key", "prop_val")},
+        count=seg, overflow=seg,
+    )
+
+    def body(st, ops):
+        # strip the leading length-1 shard axis shard_map hands us
+        local = jax.tree.map(lambda a: a[0], st)
+        out = sharded_apply_ops(local, ops, axis="seg")
+        return jax.tree.map(lambda a: a[None], out)
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+        check_vma=False))
+    out = fn(sharded, ops)
+    counts = np.asarray(out.count)
+    assert not np.asarray(out.overflow).any(), "sharded path overflowed"
+
+    ref_rows = _live_rows(
+        {f: np.asarray(getattr(ref, f)) for f in SLOT_FIELDS},
+        np.asarray(ref.count))
+    out_rows = _live_rows(
+        {f: np.asarray(getattr(out, f)) for f in SLOT_FIELDS}, counts)
+    return ref_rows, out_rows, counts
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_sharded_apply_matches_single_chip(seed):
+    ref_rows, out_rows, _ = _run_pair(seed, n_ops=48)
+    assert out_rows == ref_rows
+
+
+def test_heavy_stream_with_watermark_rebalancing():
+    """Mid-doc inserts pile onto the boundary-owning shard; a long
+    insert-heavy stream therefore needs host rebalancing between waves
+    (the bulk analog of B-tree node splits). Chunked apply with a 75%
+    watermark must track the single-chip kernel exactly."""
+    from jax.sharding import PartitionSpec as P
+
+    from fluidframework_tpu.parallel.long_doc import rebalance_shards
+
+    rng = np.random.default_rng(7)
+    n_ops, chunk = 96, 8
+    # an op can add up to 3 slots, so the rebalance watermark must leave
+    # a full chunk's worst-case growth of headroom
+    watermark = S_LOCAL - 3 * chunk
+    ops_all = jnp.asarray(generate_batch_ops(
+        rng, 1, n_ops, remove_fraction=0.15, annotate_fraction=0.05,
+        max_insert=6)[0])
+
+    ref = jax.tree.map(jnp.asarray, DocState.empty(S_GLOBAL))
+    ref = apply_ops_scan(ref, ops_all)
+    ref = compact(ref, wave_min_seq(ops_all))
+    assert not bool(ref.overflow)
+
+    mesh = make_mesh(N_SHARDS, seg_shards=N_SHARDS)
+    empty = DocState.empty(S_LOCAL)
+    all_fields = SLOT_FIELDS + ("prop_key", "prop_val")
+    state = DocState(
+        **{f: jnp.tile(getattr(empty, f), (N_SHARDS,) +
+                       (1,) * getattr(empty, f).ndim) for f in all_fields},
+        count=jnp.zeros(N_SHARDS, jnp.int32),
+        overflow=jnp.zeros(N_SHARDS, bool),
+    )
+    seg = P("seg")
+    specs = DocState(**{f: seg for f in all_fields}, count=seg, overflow=seg)
+
+    def body(st, ops):
+        local = jax.tree.map(lambda a: a[0], st)
+        out = sharded_apply_ops(local, ops, axis="seg")
+        return jax.tree.map(lambda a: a[None], out)
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+        check_vma=False))
+
+    rebalances = 0
+    for i in range(0, n_ops, chunk):
+        state = fn(state, ops_all[i:i + chunk])
+        assert not np.asarray(state.overflow).any(), f"overflow at op {i}"
+        counts = np.asarray(state.count)
+        if counts.max() > watermark:
+            arrays = {f: np.asarray(getattr(state, f)) for f in all_fields}
+            arrays, new_counts = rebalance_shards(arrays, counts)
+            state = DocState(
+                **{f: jnp.asarray(a) for f, a in arrays.items()},
+                count=jnp.asarray(new_counts),
+                overflow=jnp.zeros(N_SHARDS, bool),
+            )
+            rebalances += 1
+
+    counts = np.asarray(state.count)
+    ref_rows = _live_rows(
+        {f: np.asarray(getattr(ref, f)) for f in SLOT_FIELDS},
+        np.asarray(ref.count))
+    out_rows = _live_rows(
+        {f: np.asarray(getattr(state, f)) for f in SLOT_FIELDS}, counts)
+    assert out_rows == ref_rows
+    assert rebalances >= 1          # the stream really needed it
+    assert (counts > 0).sum() > 1   # content spans shards afterwards
