@@ -184,7 +184,21 @@ class TpuDocumentApplier:
         # check before exposing state.
         self.overflow_check_every = overflow_check_every
         self._dispatches_since_check = 0
-        self.placement = DocPlacement(n_shards=1, slots_per_shard=max_docs)
+        # the doc→shard routing table (partition-router role). In mesh
+        # mode each 'docs'-axis device owns a contiguous block of state
+        # rows (NamedSharding splits axis 0 in mesh order), so placement
+        # shard s IS device s and the global row is shard*slots + slot.
+        if mesh is not None:
+            n_shards = mesh.shape["docs"]
+            if max_docs % n_shards:
+                raise ValueError(
+                    f"max_docs={max_docs} not divisible by the mesh's "
+                    f"docs axis ({n_shards})")
+            self.placement = DocPlacement(
+                n_shards=n_shards, slots_per_shard=max_docs // n_shards)
+        else:
+            self.placement = DocPlacement(n_shards=1,
+                                          slots_per_shard=max_docs)
         self.state: DocState = jax.vmap(lambda _: DocState.empty(max_slots))(
             jnp.arange(max_docs)
         )
@@ -250,9 +264,12 @@ class TpuDocumentApplier:
     # ------------------------------------------------------------- ingest
 
     def slot_of(self, tenant_id: str, document_id: str) -> int:
+        """Global state row for a doc: the placement's (shard, slot)
+        flattened shard-major, so rows route to their owning device."""
         shard, slot = self.placement.place(tenant_id, document_id)
-        self._doc_keys.setdefault(slot, (tenant_id, document_id))
-        return slot
+        row = shard * self.placement.slots_per_shard + slot
+        self._doc_keys.setdefault(row, (tenant_id, document_id))
+        return row
 
     def _intern_client(self, slot: int, client_id: Optional[str]) -> int:
         if client_id is None:

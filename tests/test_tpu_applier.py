@@ -162,8 +162,13 @@ def test_applier_on_virtual_mesh(server, loader):
     mesh = make_mesh(8, seg_shards=1)
     applier = TpuDocumentApplier(max_docs=8, max_slots=64,
                                  ops_per_dispatch=4, mesh=mesh)
+    # mesh mode routes docs through the REAL placement table: one shard
+    # per 'docs'-axis device, global row = shard * slots_per_shard + slot
+    assert applier.placement.n_shards == 8
     for d in docs:
         feed_applier(applier, server, "t", d)
+    shards = {applier.placement.lookup("t", d)[0] for d in docs}
+    assert len(shards) > 1, "docs all hashed to one shard"
     for d in docs:
         assert applier.get_text("t", d) == strings[d].get_text()
 
