@@ -1,0 +1,126 @@
+"""Partition runtime: doc->partition routing, rebalance with checkpoint
+handoff, crash recovery from the durable checkpoint + raw-log replay
+(ref: lambdas-driver kafka-service/partitionManager.ts:22,93,
+partition.ts:24).
+"""
+
+from fluidframework_tpu.protocol.messages import DocumentMessage, MessageType
+from fluidframework_tpu.service.broadcaster import BroadcasterLambda, PubSub
+from fluidframework_tpu.service.core import InMemoryDb
+from fluidframework_tpu.service.deli import RawMessage
+from fluidframework_tpu.service.local_log import LocalLog
+from fluidframework_tpu.service.partitions import (
+    PartitionManager,
+    partition_of,
+)
+
+N_PARTS = 8
+DOCS = [f"doc{i}" for i in range(10)]
+
+
+def mk_manager():
+    log, db, pubsub = LocalLog(), InMemoryDb(), PubSub()
+    pm = PartitionManager(N_PARTS, log, db, pubsub)
+    return pm, log, db, pubsub
+
+
+def join(pm, log, doc, client_id):
+    pm.order(RawMessage(
+        tenant_id="t", document_id=doc, client_id=None,
+        operation=DocumentMessage(
+            client_sequence_number=-1, reference_sequence_number=-1,
+            type=MessageType.CLIENT_JOIN, contents={"clientId": client_id}),
+        timestamp=1.0))
+    log.drain()
+
+
+def submit(pm, log, doc, client_id, cseq, ref):
+    pm.order(RawMessage(
+        tenant_id="t", document_id=doc, client_id=client_id,
+        operation=DocumentMessage(
+            client_sequence_number=cseq, reference_sequence_number=ref,
+            type=MessageType.OPERATION, contents={"n": cseq}),
+        timestamp=1.0))
+    log.drain()
+
+
+def collect(pubsub, doc, into):
+    pubsub.subscribe(BroadcasterLambda.topic("t", doc),
+                     lambda batch: into.extend(batch))
+
+
+def test_routing_is_stable_and_spread():
+    pids = {partition_of("t", d, N_PARTS) for d in DOCS}
+    assert len(pids) > 2  # docs spread over partitions
+    assert all(partition_of("t", d, N_PARTS)
+               == partition_of("t", d, N_PARTS) for d in DOCS)
+
+
+def test_rebalance_preserves_sequencing():
+    pm, log, db, pubsub = mk_manager()
+    pm.add_host("hostA")
+    seen = {d: [] for d in DOCS}
+    for d in DOCS:
+        collect(pubsub, d, seen[d])
+        join(pm, log, d, "c1")
+        submit(pm, log, d, "c1", 1, 0)
+
+    # a second host joins: half the partitions move (checkpoint + close
+    # on A, lazy resume on B)
+    pm.add_host("hostB")
+    assert set(pm.assignment.values()) == {"hostA", "hostB"}
+    for d in DOCS:
+        submit(pm, log, d, "c1", 2, 1)
+        submit(pm, log, d, "c1", 3, 1)
+
+    for d in DOCS:
+        seqs = [m.sequence_number for m in seen[d]]
+        # join + 3 ops, dense, no duplicates, no gaps — across the move
+        assert seqs == [1, 2, 3, 4], (d, seqs)
+
+
+def test_crash_recovery_resumes_from_checkpoint():
+    pm, log, db, pubsub = mk_manager()
+    pm.add_host("hostA")
+    pm.add_host("hostB")
+    doc = DOCS[0]
+    owner = pm.assignment[partition_of("t", doc, N_PARTS)]
+    seen = []
+    collect(pubsub, doc, seen)
+    join(pm, log, doc, "c1")
+    submit(pm, log, doc, "c1", 1, 0)
+    pm.checkpoint_all()
+    submit(pm, log, doc, "c1", 2, 1)  # after the checkpoint
+
+    # the owner CRASHES: no graceful checkpoint; survivors take over and
+    # replay the raw log past the stored checkpoint
+    pm.remove_host(owner, crashed=True)
+    assert pm.assignment[partition_of("t", doc, N_PARTS)] != owner
+    submit(pm, log, doc, "c1", 3, 2)
+
+    # crash recovery is AT-LEAST-ONCE at the broadcast layer: the op
+    # ticketed after the last checkpoint is re-broadcast by the new host
+    # (clients dedupe by seq — DeltaManager drops seq <= last processed).
+    # What must hold: re-ticketed records are BYTE-IDENTICAL (same seq,
+    # same contents — deterministic replay), and sequencing continues
+    # densely with no gaps.
+    by_seq = {}
+    for m in seen:
+        if m.sequence_number in by_seq:
+            prev = by_seq[m.sequence_number]
+            assert (prev.contents, prev.client_id, prev.type) == \
+                (m.contents, m.client_id, m.type)
+        by_seq[m.sequence_number] = m
+    assert sorted(by_seq) == [1, 2, 3, 4]  # join + 3 ops, no gaps
+    assert [by_seq[s].contents.get("n") for s in (2, 3, 4)] == [1, 2, 3]
+
+
+def test_single_host_gets_everything_and_release_is_graceful():
+    pm, log, db, pubsub = mk_manager()
+    host = pm.add_host("solo")
+    for d in DOCS:
+        join(pm, log, d, "c1")
+    assert set(pm.assignment.values()) == {"solo"}
+    assert sum(len(p.orderers) for p in host.partitions.values()) == len(DOCS)
+    pm.remove_host("solo")
+    assert not pm.assignment
