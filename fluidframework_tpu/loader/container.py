@@ -29,8 +29,10 @@ class Container:
         self,
         service: DocumentService,
         runtime_factory: Optional[Callable[["Container"], ContainerRuntime]] = None,
+        code_loader=None,
     ):
         self._service = service
+        self._code_loader = code_loader
         self.storage = service.connect_to_storage()
         self.delta_manager = DeltaManager(service)
         self.delta_manager.process_handler = self._process
@@ -68,7 +70,14 @@ class Container:
             self.delta_manager.last_processed_seq = snapshot["sequence_number"]
         else:
             self.protocol = ProtocolOpHandler()
-        self.runtime = self._runtime_factory(self)
+        # the quorum-agreed code proposal picks the runtime factory when
+        # a code loader is wired (ref: loadRuntimeFactory container.ts:1241)
+        factory = self._runtime_factory
+        if self._code_loader is not None:
+            agreed = self._code_loader.factory_for(self)
+            if agreed is not None:
+                factory = agreed
+        self.runtime = factory(self)
         if snapshot is not None:
             self.runtime.load_snapshot(snapshot["runtime"],
                                        base_seq=snapshot["sequence_number"])
@@ -141,6 +150,13 @@ class Container:
             MessageType.PROPOSE, {"key": key, "value": value}
         )
 
+    def propose_code(self, details: Any) -> None:
+        """Propose the container code through the quorum — every replica
+        boots the agreed package after commit (ref: "code" proposals)."""
+        from .code_loader import CODE_KEY
+
+        self.propose(CODE_KEY, details)
+
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self.delta_manager.submit_signal(content, type)
 
@@ -190,15 +206,18 @@ class Loader:
         self,
         factory: DocumentServiceFactory,
         runtime_factory: Optional[Callable[[Container], ContainerRuntime]] = None,
+        code_loader=None,
     ):
         self._factory = factory
         self._runtime_factory = runtime_factory
+        self._code_loader = code_loader
 
     def resolve(
         self, tenant_id: str, document_id: str, connect: bool = True
     ) -> Container:
         service = self._factory.create_document_service(tenant_id, document_id)
-        return Container(service, self._runtime_factory).load(connect)
+        return Container(service, self._runtime_factory,
+                         code_loader=self._code_loader).load(connect)
 
     def create_detached(self, tenant_id: str, document_id: str) -> Container:
         """A container that lives entirely client-side until ``attach()``

@@ -1,0 +1,77 @@
+"""Code loader: the quorum-agreed "code" proposal selects the runtime
+factory every replica boots (ref: container.ts:1241 loadRuntimeFactory,
+web-code-loader, "code" quorum proposals).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.runtime.container_runtime import ContainerRuntime
+from fluidframework_tpu.runtime.summarizer import SummaryManager
+from fluidframework_tpu.service import LocalServer
+
+
+class RuntimeV1(ContainerRuntime):
+    code_version = "v1"
+
+
+class RuntimeV2(ContainerRuntime):
+    code_version = "v2"
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+def make_loader(server):
+    code = CodeLoader()
+    code.register("app/v1", RuntimeV1)
+    code.register("app/v2", RuntimeV2)
+    return Loader(LocalDocumentServiceFactory(server), code_loader=code)
+
+
+def commit_proposals(container):
+    """Quorum proposals commit when the msn passes them (unanimous
+    silence); a couple of noops advance the single client's refSeq."""
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    container.delta_manager.submit(MessageType.NOOP, None)
+    container.delta_manager.submit(MessageType.NOOP, None)
+
+
+def test_agreed_code_selects_runtime_on_boot(server):
+    loader = make_loader(server)
+    c1 = loader.resolve("t", "doc")
+    c1.propose_code({"package": "app/v2", "config": {}})
+    commit_proposals(c1)
+    assert c1.quorum.get("code")["package"] == "app/v2"
+    ds = c1.runtime.create_data_store("default")
+    ds.create_channel("text", "shared-string").insert_text(0, "hi")
+    SummaryManager(c1, max_ops=10**9).summarize_now()
+
+    # a fresh replica boots from the summary whose quorum carries the
+    # agreed code: it instantiates the v2 runtime
+    c2 = loader.resolve("t", "doc")
+    assert type(c2.runtime) is RuntimeV2
+    assert c2.runtime.get_data_store("default") \
+        .get_channel("text").get_text() == "hi"
+
+
+def test_unregistered_package_fails_boot(server):
+    loader = make_loader(server)
+    c1 = loader.resolve("t", "doc")
+    c1.propose_code({"package": "app/v3-not-installed"})
+    commit_proposals(c1)
+    c1.runtime.create_data_store("default")
+    SummaryManager(c1, max_ops=10**9).summarize_now()
+    with pytest.raises(KeyError, match="v3-not-installed"):
+        loader.resolve("t", "doc")
+
+
+def test_without_proposal_default_factory_boots(server):
+    loader = make_loader(server)
+    c1 = loader.resolve("t", "doc")
+    assert type(c1.runtime) is ContainerRuntime  # the stock default
