@@ -1,0 +1,68 @@
+"""AgentScheduler: exclusive task ownership over register consensus,
+reassignment on owner departure (ref: agent-scheduler scheduler.ts:34).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime.agent_scheduler import AgentScheduler
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def boot_pair(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    c1.runtime.create_data_store("default")
+    s1 = AgentScheduler(c1)
+    s2 = AgentScheduler(c2)
+    return c1, c2, s1, s2
+
+
+def test_exactly_one_volunteer_wins(loader):
+    c1, c2, s1, s2 = boot_pair(loader)
+    s1.pick("intel")
+    s2.pick("intel")
+    assert s1.owner("intel") == s2.owner("intel")
+    assert s1.owns("intel") != s2.owns("intel")  # exactly one
+
+
+def test_ownership_transfers_on_leave(loader):
+    c1, c2, s1, s2 = boot_pair(loader)
+    events = []
+    s1.pick("summarizer", lambda owned: events.append(("c1", owned)))
+    s2.pick("summarizer", lambda owned: events.append(("c2", owned)))
+    first_owner = s1.owner("summarizer")
+    loser = s2 if s1.owns("summarizer") else s1
+    winner_container = c1 if s1.owns("summarizer") else c2
+    winner_container.close()  # sequenced leave reaches the survivor
+    assert loser.owns("summarizer")
+    assert loser.owner("summarizer") != first_owner
+    assert ("c1", True) in events or ("c2", True) in events
+
+
+def test_release_hands_off_to_volunteer(loader):
+    c1, c2, s1, s2 = boot_pair(loader)
+    s1.pick("task")
+    assert s1.owns("task")
+    s2.pick("task")
+    assert not s2.owns("task")
+    s1.release("task")
+    assert s2.owns("task") and not s1.owns("task")
+
+
+def test_owner_visible_from_non_volunteers(loader):
+    c1, c2, s1, s2 = boot_pair(loader)
+    s1.pick("solo")
+    assert s2.owner("solo") == c1.client_id
+    assert "solo" in s2.tasks

@@ -270,3 +270,41 @@ def test_idle_eviction_rides_raw_log_for_deterministic_replay():
     ]
     assert deltas_after == deltas_before
     assert c1.client_id not in orderer2.deli.clients
+
+
+def test_copier_archives_raw_traffic_including_rejected():
+    """Copier (lambdas/src/copier): the raw archive keeps what deli
+    NACKED too — the sequenced log only shows accepted traffic."""
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.service import LocalServer
+    from fluidframework_tpu.service.copier import CopierLambda
+
+    server = LocalServer()
+    copier = CopierLambda(server.db)
+    conn = server.connect("t", "doc")
+    # subscribe the copier to the doc's raw topic like any other lambda
+    orderer = server._get_orderer("t", "doc")
+    server.log.subscribe(orderer.raw_topic, copier.handler, from_offset=0)
+
+    conn.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"good": 1})])
+    nacks = []
+    conn.on_nack = lambda n: nacks.append(n)
+    conn.submit([DocumentMessage(
+        client_sequence_number=9, reference_sequence_number=0,  # gap
+        type=MessageType.OPERATION, contents={"bad": 1})])
+    assert nacks  # deli refused it
+
+    rows = copier.archive("t", "doc")
+    kinds = [(r["kind"], r.get("clientSeq") or
+              (r["ops"][0]["clientSeq"] if r.get("ops") else None))
+             for r in rows]
+    # join (raw) + accepted boxcar + the NACKED boxcar are all archived
+    assert ("raw", -1) in kinds
+    assert ("boxcar", 1) in kinds
+    assert ("boxcar", 9) in kinds  # the rejected submission is auditable
+    assert copier.copied == len(rows)
