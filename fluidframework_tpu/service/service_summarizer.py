@@ -1,0 +1,93 @@
+"""Service-generated summaries from TPU device state.
+
+Ref: scribe's writeServiceSummary (scribe/summaryWriter.ts:226) — the
+reference's server can persist a service summary without any client
+summarizer, but must REPLAY the op log in JS to get content. Here the
+TpuDocumentApplier already holds every doc's converged merge-tree on
+device, so a service summary is a decode + upload: the scribe-replay
+batch pass of BASELINE config 5, productized.
+
+Scope (by design): the device models merge-tree channels. Documents
+whose data stores hold ONLY device-modeled channels get full service
+summaries; anything else must keep client summaries — the summarizer
+refuses rather than writing a summary that would boot clients into
+truncated state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..driver.local import LocalStorage
+from .core import summary_versions_collection
+
+DS_ID = "default"
+TEXT_CHANNEL = "text"
+
+
+class ServiceSummarizer:
+    """Writes acked summaries straight from the applier's device state."""
+
+    def __init__(self, server, applier, ds_id: str = DS_ID,
+                 channel_id: str = TEXT_CHANNEL):
+        self.server = server
+        self.applier = applier
+        self.ds_id = ds_id
+        self.channel_id = channel_id
+        self.summaries_written = 0
+
+    def summarize_doc(self, tenant_id: str, document_id: str) -> str:
+        """Decode the doc from the device, compose a bootable container
+        summary with scribe's protocol replica, upload, and ack it
+        (scribe itself is the validator — a service summary commits
+        directly, the writeServiceSummary contract)."""
+        orderer = self.server._get_orderer(tenant_id, document_id)
+        scribe = orderer.scribe
+        replica = self.applier.get_tree(tenant_id, document_id)
+        summary = {
+            "protocol": scribe.protocol.snapshot(),
+            "runtime": {
+                "dataStores": {
+                    self.ds_id: {
+                        "pkg": "default",
+                        "snapshot": {
+                            "channels": {
+                                self.channel_id: {
+                                    "type": "shared-string",
+                                    "snapshot": {
+                                        "mergetree": replica.snapshot(),
+                                        "intervals": {},
+                                    },
+                                },
+                            }
+                        },
+                    }
+                }
+            },
+            "sequence_number": scribe.protocol.sequence_number,
+        }
+        storage = LocalStorage(self.server, tenant_id, document_id)
+        version_id = storage.upload_summary(
+            summary, parent=scribe.last_summary_head)
+        # the service is its own validator: flip the ref directly
+        col = summary_versions_collection(tenant_id, document_id)
+        version = self.server.db.find_one(col, version_id)
+        self.server.db.upsert(col, version_id, dict(version, acked=True))
+        scribe.last_summary_head = version_id
+        self.summaries_written += 1
+        return version_id
+
+    def summarize_all(self, tenant_id: str, documents: list[str],
+                      min_seq: Optional[int] = None) -> int:
+        """The batch pass (BASELINE config 5): one device fence, then a
+        decode+upload per doc. Returns the number summarized."""
+        self.applier.finalize()  # one fence for the whole batch
+        n = 0
+        for doc in documents:
+            orderer = self.server._get_orderer(tenant_id, doc)
+            if min_seq is not None and \
+                    orderer.deli.sequence_number < min_seq:
+                continue
+            self.summarize_doc(tenant_id, doc)
+            n += 1
+        return n

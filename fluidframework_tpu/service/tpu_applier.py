@@ -662,6 +662,10 @@ class TpuDocumentApplier:
                             self.prop_table)
         replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
         replica.tree = tree
+        # carry the interning table: in-window stamps must translate back
+        # to wire client ids when this replica snapshots (service
+        # summaries would otherwise lose attribution)
+        replica._ids.update(self._client_ids.get(slot, {}))
         return replica
 
     def get_properties_at(self, tenant_id: str, document_id: str,

@@ -1,0 +1,83 @@
+"""Service summaries from device state (writeServiceSummary via the TPU
+applier — the productized scribe-replay pass, BASELINE config 5).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+from fluidframework_tpu.service.service_summarizer import ServiceSummarizer
+from fluidframework_tpu.service.tpu_applier import (
+    TpuDocumentApplier,
+    channel_stream,
+)
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def feed(applier, server, tenant, doc):
+    for m in channel_stream(server, tenant, doc, "default", "text"):
+        applier.ingest(tenant, doc, m, m.contents)
+
+
+def test_boot_from_service_summary_without_client_summarizer(server, loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "server-side summaries ")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(0, ">> ")
+    s1.annotate_range(0, 2, {"bold": True})
+    assert s1.get_text() == s2.get_text()
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "doc")
+    svc = ServiceSummarizer(server, applier)
+    version = svc.summarize_doc("t", "doc")
+    assert version is not None and svc.summaries_written == 1
+
+    # NO client ever summarized — yet a fresh client boots from the
+    # service summary + tail and stays live
+    c3 = loader.resolve("t", "doc")
+    assert c3._base_snapshot is not None
+    s3 = c3.runtime.get_data_store("default").get_channel("text")
+    assert s3.get_text() == s1.get_text()
+    assert s3.client.get_properties_at(0).get("bold") is True
+    s3.insert_text(0, "live! ")
+    assert s1.get_text() == s3.get_text() == s2.get_text()
+
+
+def test_batch_service_summaries(server, loader):
+    docs = [f"d{i}" for i in range(6)]
+    strings = {}
+    applier = TpuDocumentApplier(max_docs=8, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    for d in docs:
+        c = loader.resolve("t", d)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, f"content of {d}")
+        strings[d] = s
+        feed(applier, server, "t", d)
+
+    svc = ServiceSummarizer(server, applier)
+    assert svc.summarize_all("t", docs) == len(docs)
+
+    for d in docs:
+        c = loader.resolve("t", d)
+        assert c._base_snapshot is not None
+        assert (c.runtime.get_data_store("default").get_channel("text")
+                .get_text() == strings[d].get_text())
