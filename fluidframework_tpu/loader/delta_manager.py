@@ -50,6 +50,14 @@ class DeltaManager:
         self.inbound_yield: Optional[Callable[[int], None]] = None
         self.inbound_slice = 256
         self._drained_since_yield = 0
+        # noop heartbeat (ref: submit coalescing + noop heuristics,
+        # deltaManager.ts:583): a watch-only client must still advance
+        # its refSeq through the sequencer or it pins the document's msn
+        # — and with it the collaboration window and the device zamboni
+        # floor. After this many remote ops with no local submission, a
+        # NOOP goes out. 0 disables.
+        self.noop_frequency = 50
+        self._remote_since_submit = 0
 
     @property
     def connected(self) -> bool:
@@ -125,6 +133,7 @@ class DeltaManager:
         """Send one message on the live connection; returns clientSeq."""
         if self.connection is None:
             raise RuntimeError("cannot submit while disconnected")
+        self._remote_since_submit = 0
         self._client_seq += 1
         self.connection.submit(
             [
@@ -189,12 +198,27 @@ class DeltaManager:
             # a gap remains: repair from delta storage
             self._fetch_missing(upto=min(self._reorder))
             self._drain_reorder()
+        self._maybe_heartbeat()
+
+    def _maybe_heartbeat(self) -> None:
+        """Send the refSeq-advancing NOOP when we have only been
+        watching (outside the drain loop: submitting mid-drain would
+        re-enter processing on a synchronous service)."""
+        if (
+            self.noop_frequency
+            and self.connection is not None
+            and self._remote_since_submit >= self.noop_frequency
+        ):
+            self._remote_since_submit = 0
+            self.submit(MessageType.NOOP, None)
 
     def _drain_reorder(self) -> None:
         while self.last_processed_seq + 1 in self._reorder:
             msg = self._reorder.pop(self.last_processed_seq + 1)
             self.last_processed_seq = msg.sequence_number
             self.minimum_sequence_number = msg.minimum_sequence_number
+            if msg.client_id is not None and msg.client_id != self.client_id:
+                self._remote_since_submit += 1
             if self.process_handler:
                 self.process_handler(msg)
             if self.inbound_yield is not None:

@@ -468,6 +468,11 @@ class DeliLambda:
             if client_id not in self.clients:
                 return  # duplicate leave
             self._sequence_system(MessageType.CLIENT_LEAVE, op.contents, now)
+            if not self.clients:
+                # the doc went quiet: the NoClient marker tells scribe a
+                # service summary can capture final state (ref: deli
+                # sending NoClient, protocol.ts MessageType.noClient)
+                self._sequence_system(MessageType.NO_CLIENT, None, now)
             return
 
         if raw.client_id is None:

@@ -121,3 +121,37 @@ def test_read_scope_connection_watches_but_cannot_write():
     # the nacked op was never sequenced
     assert all(m.client_id != reader.client_id or m.type.value != "op"
                for m in server.get_deltas("acme", "doc", 0, 10**9))
+
+
+def test_watch_only_client_heartbeats_and_msn_advances(server, loader):
+    """A watcher that never edits must not pin the msn: after enough
+    remote ops it sends a refSeq-advancing NOOP (deltaManager.ts:583
+    noop heuristics), letting the collaboration window move."""
+    editor = loader.resolve("t", "doc")
+    watcher = loader.resolve("t", "doc")
+    watcher.delta_manager.noop_frequency = 10
+    s = editor.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(30):
+        s.insert_text(0, "x")
+    deli = server._get_orderer("t", "doc").deli
+    watcher_state = deli.clients[watcher.client_id]
+    # the watcher's refSeq tracked the stream via heartbeats
+    assert watcher_state.reference_sequence_number > 0
+    lag = deli.sequence_number - deli._min_ref_seq()
+    assert lag <= 2 * watcher.delta_manager.noop_frequency
+
+
+def test_no_client_marker_when_doc_goes_quiet(server, loader):
+    c1 = loader.resolve("t", "doc")
+    seen = []
+    conn = server.connect("t", "watchdoc")  # raw connection to observe
+    conn.on_ops = lambda batch: seen.extend(batch)
+    c2 = loader.resolve("t", "watchdoc")
+    c2.close()
+    conn.disconnect()  # last client leaves → NO_CLIENT marker
+    types = [m.type.value for m in seen]
+    assert "noClient" not in types  # c2's leave: conn still present
+    # check the sequenced log directly for the marker after the LAST leave
+    log = server.get_deltas("t", "watchdoc", 0, 10**9)
+    assert [m.type.value for m in log][-1] == "noClient"
