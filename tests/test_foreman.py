@@ -1,0 +1,58 @@
+"""Foreman task broker: assignment, heartbeat expiry, reassignment on
+worker death, stale-completion rejection (ref: lambdas/src/foreman).
+"""
+
+from fluidframework_tpu.service.foreman import Foreman
+
+
+def mk(clock):
+    return Foreman(clock=lambda: clock[0], worker_timeout=10.0)
+
+
+def test_tasks_spread_least_loaded_and_complete():
+    clock = [0.0]
+    f = mk(clock)
+    got = {"a": [], "b": []}
+    f.register_worker("a", lambda t: got["a"].append(t))
+    f.register_worker("b", lambda t: got["b"].append(t))
+    for i in range(6):
+        f.enqueue(f"t{i}", {"n": i})
+    assert len(got["a"]) == 3 and len(got["b"]) == 3
+    for t in got["a"] + got["b"]:
+        worker = "a" if t in got["a"] else "b"
+        assert f.complete(worker, t["task_id"], t["payload"]["n"] * 2)
+    assert f.pending_count() == 0
+    assert f.result("t4") == 8
+
+
+def test_dead_worker_tasks_reassign_and_stale_completion_refused():
+    clock = [0.0]
+    f = mk(clock)
+    got = {"a": [], "b": []}
+    f.register_worker("a", lambda t: got["a"].append(t))
+    f.enqueue("job", {"x": 1})
+    assert len(got["a"]) == 1  # only worker gets it
+
+    clock[0] = 5.0
+    f.register_worker("b", lambda t: got["b"].append(t))
+    clock[0] = 20.0
+    f.heartbeat("b")
+    f.check_workers()  # a silent past timeout → dropped, job requeued
+    assert f.reassignments == 1
+    assert len(got["b"]) == 1 and got["b"][0]["attempt"] == 2
+
+    # the zombie's late result must NOT overwrite the live attempt
+    assert not f.complete("a", "job", "stale result")
+    assert f.result("job") is None
+    assert f.complete("b", "job", "fresh result")
+    assert f.result("job") == "fresh result"
+
+
+def test_tasks_queue_until_a_worker_exists():
+    clock = [0.0]
+    f = mk(clock)
+    f.enqueue("early", {"k": 1})
+    assert f.pending_count() == 1
+    got = []
+    f.register_worker("late", got.append)
+    assert [t["task_id"] for t in got] == ["early"]

@@ -1,0 +1,61 @@
+"""Intelligence runner agent: scheduler-elected analysis published back
+into the document (ref: intelligence-runner-agent, headless-agent).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime.intel_runner import IntelRunner
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def server():
+    return LocalServer()
+
+
+@pytest.fixture
+def loader(server):
+    return Loader(LocalDocumentServiceFactory(server))
+
+
+def test_single_runner_analyzes_and_everyone_sees(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    text.insert_text(0, "hello collaborative world")
+    r1 = IntelRunner(c1)
+    r2 = IntelRunner(c2)
+    assert r1.is_running != r2.is_running  # exactly one works
+
+    # analysis converged to every replica through the total order
+    res2 = c2.runtime.get_data_store("default").get_channel("intel-results")
+    assert res2.get("words") == 3
+    assert res2.get("longest_word") == "collaborative"
+
+    # live re-analysis on edits from ANY client
+    editor = (c2 if r1.is_running else c1).runtime \
+        .get_data_store("default").get_channel("text")
+    editor.insert_text(0, "extraordinarily ")
+    assert res2.get("words") == 4
+    assert res2.get("longest_word") == "extraordinarily"
+
+
+def test_runner_fails_over_on_departure(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    text.insert_text(0, "one two")
+    r1 = IntelRunner(c1)
+    r2 = IntelRunner(c2)
+    worker, standby = (r1, r2) if r1.is_running else (r2, r1)
+    worker.container.close()
+    assert standby.is_running
+    s2 = standby.container.runtime.get_data_store("default") \
+        .get_channel("text")
+    s2.insert_text(0, "zero ")
+    assert standby.results.get("words") == 3
+    assert standby.results.get("analyzed_by") == standby.container.client_id
