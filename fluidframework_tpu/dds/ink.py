@@ -130,12 +130,29 @@ class SharedSummaryBlock(SharedObject):
     def __init__(self, channel_id: str):
         super().__init__(channel_id)
         self._data: dict[str, Any] = {}
+        self._dirty_at = 0
 
     def set(self, key: str, value: Any) -> None:
         self._data[key] = value
+        # local-only writes never sequence, so the base class's
+        # last_changed_seq cannot see them: mark changed past the current
+        # STREAM head to disqualify summary handle reuse until a summary
+        # whose capture seq passes this point has uploaded the write
+        head_fn = getattr(self, "_head_fn", None)
+        head = head_fn() if head_fn is not None else self.last_changed_seq
+        self._dirty_at = max(self._dirty_at, head + 1)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._data.get(key, default)
+
+    def summarize(self, path, parent_capture_seq=None):
+        """A write marked at head+1 is covered by any ACKED summary whose
+        capture seq reached that point (its upload read current _data);
+        until then, force a fresh subtree upload."""
+        if parent_capture_seq is not None \
+                and self._dirty_at > parent_capture_seq:
+            parent_capture_seq = None
+        return super().summarize(path, parent_capture_seq)
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
         raise RuntimeError("SharedSummaryBlock never sends or receives ops")

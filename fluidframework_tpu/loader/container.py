@@ -139,6 +139,16 @@ class Container:
         return self.protocol.quorum
 
     @property
+    def blob_manager(self):
+        """Attachment blobs (ref: blobManager.ts): payloads live in the
+        content-addressed store, only handles ride the op stream."""
+        if not hasattr(self, "_blob_manager"):
+            from .blob_manager import BlobManager
+
+            self._blob_manager = BlobManager(self.storage)
+        return self._blob_manager
+
+    @property
     def audience(self) -> dict[str, SequencedClient]:
         """Connected clients as known through the total order (join/leave)."""
         return dict(self.protocol.quorum.members)

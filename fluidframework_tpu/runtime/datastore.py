@@ -56,6 +56,10 @@ class DataStoreRuntime:
             ),
             is_connected=lambda: self.runtime.connected,
         )
+        # stream-head accessor for channels whose state changes without
+        # ops (shared-summary-block dirty tracking)
+        channel._head_fn = (
+            lambda: self.runtime.container.delta_manager.last_processed_seq)
         if self.runtime.connected:
             channel.set_connection_state(True, self.runtime.client_id)
 
