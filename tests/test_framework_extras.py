@@ -1,0 +1,73 @@
+"""Framework extras: DI synthesis, request routing, last-edited tracker
+(ref: packages/framework/synthesize, request-handler,
+last-edited-experimental).
+"""
+
+import pytest
+
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.framework.last_edited import LastEditedTracker
+from fluidframework_tpu.framework.request_handler import RequestRouter
+from fluidframework_tpu.framework.synthesize import DependencyContainer
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalServer
+
+
+@pytest.fixture
+def loader():
+    return Loader(LocalDocumentServiceFactory(LocalServer()))
+
+
+def test_dependency_container_required_optional_and_scopes():
+    host = DependencyContainer()
+    host.register("logger", "host-logger")
+    built = []
+    host.register_factory("expensive", lambda: built.append(1) or "svc")
+    child = DependencyContainer(parent=host)
+    child.register("config", {"x": 1})
+
+    deps = child.synthesize(required=("logger", "config"),
+                            optional=("missing", "expensive"))
+    assert deps["logger"] == "host-logger"
+    assert deps["config"] == {"x": 1}
+    assert deps["missing"] is None
+    assert deps["expensive"] == "svc"
+    child.resolve("expensive")
+    assert built == [1]  # factory ran once (cached)
+    with pytest.raises(KeyError):
+        child.synthesize(required=("nope",))
+
+
+def test_request_router_walks_the_object_graph(loader):
+    c = loader.resolve("t", "doc")
+    ds = c.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    router = RequestRouter(c)
+    assert router.request("/") is c.runtime
+    assert router.request("/default") is ds
+    assert router.request("/default/text") is text
+    with pytest.raises(KeyError):
+        router.request("/nope/where")
+    # custom handlers compose in front
+    router.add_handler(
+        lambda parts, cont: "CUSTOM" if parts[:1] == ["_debug"] else None)
+    assert router.request("/_debug/state") == "CUSTOM"
+    assert router.request("/default/text") is text  # default still works
+
+
+def test_last_edited_converges_and_names_the_editor(loader):
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    ds = c1.runtime.create_data_store("default")
+    text = ds.create_channel("text", "shared-string")
+    t1 = LastEditedTracker(c1)
+    t2 = LastEditedTracker(c2)
+
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(0, "bob was here")
+    assert t1.last_edited is not None
+    assert t1.last_edited["clientId"] == c2.client_id
+    assert t1.last_edited == t2.last_edited  # convergent record
+
+    text.insert_text(0, "alice later: ")
+    assert t2.last_edited["clientId"] == c1.client_id
