@@ -219,27 +219,36 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
 
 class NetworkDeltaStorage(DocumentDeltaStorage):
-    def __init__(self, transport: _Transport, tenant_id: str, document_id: str):
+    def __init__(self, transport: _Transport, tenant_id: str,
+                 document_id: str, token_provider=None):
         self._t = transport
         self._tenant = tenant_id
         self._doc = document_id
+        self._token_provider = token_provider
 
     def get_deltas(self, from_seq: int, to_seq: int):
+        token = (self._token_provider(self._tenant, self._doc)
+                 if self._token_provider else None)
         reply = self._t.request({
             "t": "get_deltas", "tenant": self._tenant, "doc": self._doc,
-            "from": from_seq, "to": to_seq})
+            "from": from_seq, "to": to_seq, "token": token})
         return [message_from_dict(d) for d in reply["msgs"]]
 
 
 class NetworkStorage(DocumentStorage):
-    def __init__(self, transport: _Transport, tenant_id: str, document_id: str):
+    def __init__(self, transport: _Transport, tenant_id: str,
+                 document_id: str, token_provider=None):
         self._t = transport
         self._tenant = tenant_id
         self._doc = document_id
+        self._token_provider = token_provider
 
     def _req(self, t: str, **kw) -> dict:
+        token = (self._token_provider(self._tenant, self._doc)
+                 if self._token_provider else None)
         return self._t.request(
-            {"t": t, "tenant": self._tenant, "doc": self._doc, **kw})
+            {"t": t, "tenant": self._tenant, "doc": self._doc,
+             "token": token, **kw})
 
     def get_versions(self, count: int = 1) -> list[dict]:
         return self._req("get_versions", count=count)["versions"]
@@ -295,10 +304,12 @@ class NetworkDocumentService(DocumentService):
                                       token=token)
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
-        return NetworkDeltaStorage(self._rpc_transport(), self._tenant, self._doc)
+        return NetworkDeltaStorage(self._rpc_transport(), self._tenant,
+                                   self._doc, self._token_provider)
 
     def connect_to_storage(self) -> NetworkStorage:
-        return NetworkStorage(self._rpc_transport(), self._tenant, self._doc)
+        return NetworkStorage(self._rpc_transport(), self._tenant,
+                              self._doc, self._token_provider)
 
 
 class NetworkDocumentServiceFactory(DocumentServiceFactory):

@@ -236,7 +236,8 @@ class Loader:
         pending state, and attach() replays it through the normal
         pending-op machinery as the document's first ops."""
         service = self._factory.create_document_service(tenant_id, document_id)
-        container = Container(service, self._runtime_factory).load(
+        container = Container(service, self._runtime_factory,
+                              code_loader=self._code_loader).load(
             connect=False)
         container.detached = True
         return container

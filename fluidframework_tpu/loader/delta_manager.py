@@ -217,7 +217,14 @@ class DeltaManager:
             msg = self._reorder.pop(self.last_processed_seq + 1)
             self.last_processed_seq = msg.sequence_number
             self.minimum_sequence_number = msg.minimum_sequence_number
-            if msg.client_id is not None and msg.client_id != self.client_id:
+            if (
+                msg.client_id is not None
+                and msg.client_id != self.client_id
+                and msg.type is not MessageType.NOOP
+            ):
+                # only CONTENT traffic triggers heartbeats: counting other
+                # clients' noops would make the heartbeats self-sustaining
+                # once the client count passes noop_frequency (a storm)
                 self._remote_since_submit += 1
             if self.process_handler:
                 self.process_handler(msg)

@@ -83,7 +83,7 @@ class AgentScheduler:
         # vacancies appear on owner CLIENT_LEAVE or an explicit release
         # write; every volunteer re-bids at the same total-order point
         # and the register consensus picks one winner
-        for task in self._wanted:
+        for task in list(self._wanted):
             if self.owner(task) is not None:
                 self._bid_pending.discard(task)  # race resolved
             else:
@@ -91,7 +91,8 @@ class AgentScheduler:
         self._refresh()
 
     def _refresh(self) -> None:
-        for task, cb in self._wanted.items():
+        # snapshot: callbacks may pick()/release() (one-shot tasks)
+        for task, cb in list(self._wanted.items()):
             owned_now = self.owns(task)
             was = task in self._owned
             if owned_now and not was:

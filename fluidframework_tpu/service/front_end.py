@@ -99,9 +99,12 @@ class _ClientSession:
         self._dropping = False
         self._loop = asyncio.get_running_loop()
         # gateway-mode state: sid → ServerConnection, and the doc topics
-        # this gateway subscribes (each exactly once)
+        # this gateway subscribes (each exactly once, refcounted by its
+        # live sessions so the last fdisconnect unsubscribes)
         self._fsessions: dict[int, ServerConnection] = {}
         self._ftopics: dict[str, object] = {}  # topic → pubsub callbacks
+        self._ftopic_refs: dict[str, int] = {}
+        self._fsession_topics: dict[int, str] = {}
 
     # -- push events (called synchronously from the pipeline drain, which
     # runs on the loop thread) --
@@ -229,12 +232,15 @@ class _ClientSession:
                     self.conn.disconnect()
                     self.conn = None
             elif t == "get_deltas":
+                self._check_rpc_auth(frame, write=False)
                 msgs = server.get_deltas(
                     frame["tenant"], frame["doc"], frame["from"], frame["to"])
                 self.push("deltas", {
                     "rid": rid, "msgs": [message_to_dict(m) for m in msgs]})
             elif t in ("get_versions", "get_tree", "read_blob",
                        "write_blob", "upload_summary"):
+                self._check_rpc_auth(
+                    frame, write=t in ("write_blob", "upload_summary"))
                 self._handle_storage(t, frame, rid)
             elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
                 self._handle_gateway(t, frame, rid)
@@ -258,6 +264,10 @@ class _ClientSession:
             from .broadcaster import BroadcasterLambda
 
             tenant, doc = frame["tenant"], frame["doc"]
+            # validate BEFORE creating the topic subscription: a refused
+            # connect must not leak a subscription
+            if server.tenants is not None:
+                server.tenants.validate(frame.get("token"), tenant, doc)
             topic = BroadcasterLambda.topic(tenant, doc)
             # the gateway's topic subscription must exist BEFORE the join
             # is ordered: connect() sequences + broadcasts the join
@@ -279,6 +289,8 @@ class _ClientSession:
             conn = server.connect(tenant, doc, frame.get("details"),
                                   token=frame.get("token"))
             self._fsessions[sid] = conn
+            self._fsession_topics[sid] = topic
+            self._ftopic_refs[topic] = self._ftopic_refs.get(topic, 0) + 1
             # drop the per-connection op/signal subscriptions (the topic
             # subscription above covers them ONCE per gateway — and their
             # handler-less buffers would otherwise grow unbounded); nacks
@@ -315,9 +327,31 @@ class _ClientSession:
             conn = self._fsessions[frame["sid"]]
             conn.submit_signal(frame["content"], frame.get("type", "signal"))
         elif t == "fdisconnect":
-            conn = self._fsessions.pop(frame["sid"], None)
+            sid = frame["sid"]
+            conn = self._fsessions.pop(sid, None)
             if conn is not None:
                 conn.disconnect()
+            topic = self._fsession_topics.pop(sid, None)
+            if topic is not None:
+                self._ftopic_refs[topic] -= 1
+                if self._ftopic_refs[topic] == 0:
+                    # the gateway's last session on this doc is gone:
+                    # stop encoding/pushing its broadcasts
+                    del self._ftopic_refs[topic]
+                    self._unsubscribe_ftopic(topic)
+
+    def _check_rpc_auth(self, frame: dict, write: bool) -> None:
+        """Tenancy applies to the REST-role endpoints too: delta backfill
+        and storage reads need doc:read, blob/summary writes need
+        doc:write — otherwise a tokenless connection could read a secured
+        doc's whole op stream or write into its storage."""
+        tenants = self.front.server.tenants
+        if tenants is None:
+            return
+        from .tenants import SCOPE_READ, SCOPE_WRITE
+
+        tenants.validate(frame.get("token"), frame["tenant"], frame["doc"],
+                         required_scope=SCOPE_WRITE if write else SCOPE_READ)
 
     def _handle_storage(self, t: str, frame: dict, rid) -> None:
         from ..driver.local import LocalStorage
@@ -344,6 +378,14 @@ class _ClientSession:
                 "id": storage.upload_summary(frame["summary"],
                                              frame.get("parent"))})
 
+    def _unsubscribe_ftopic(self, topic: str) -> None:
+        entry = self._ftopics.pop(topic, None)
+        if entry is not None:
+            on_batch, on_signal, sig_topic = entry
+            pubsub = self.front.server.pubsub
+            pubsub.unsubscribe(topic, on_batch)
+            pubsub.unsubscribe(sig_topic, on_signal)
+
     def closed(self) -> None:
         if self.conn is not None:
             self.conn.disconnect()
@@ -351,13 +393,10 @@ class _ClientSession:
         for conn in self._fsessions.values():
             conn.disconnect()
         self._fsessions.clear()
-        if self._ftopics:
-            pubsub = self.front.server.pubsub
-            for topic, (on_batch, on_signal, sig_topic) in \
-                    self._ftopics.items():
-                pubsub.unsubscribe(topic, on_batch)
-                pubsub.unsubscribe(sig_topic, on_signal)
-            self._ftopics.clear()
+        self._fsession_topics.clear()
+        self._ftopic_refs.clear()
+        for topic in list(self._ftopics):
+            self._unsubscribe_ftopic(topic)
 
 
 class NetworkFrontEnd:

@@ -101,6 +101,8 @@ class _GatewaySession:
                 peers = self.gw.topic_sessions.get(self.topic)
                 if peers is not None:
                     peers.discard(self)
+                    if not peers:  # prune emptied topics
+                        self.gw.topic_sessions.pop(self.topic, None)
             self.gw.upstream_send({"t": "fdisconnect", "sid": self.sid})
             self.sid = None
 
@@ -194,7 +196,13 @@ class Gateway:
                 frame = await _read_frame(reader)
                 if frame is None:
                     break
-                await session.handle(frame)
+                try:
+                    await session.handle(frame)
+                except (RuntimeError, ConnectionError) as e:
+                    # a core error reply (auth refusal, storage failure)
+                    # answers THIS request — it must not kill the socket
+                    session.push({"t": "error", "rid": frame.get("rid"),
+                                  "message": str(e)})
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass

@@ -138,3 +138,48 @@ def test_config_sets_front_end_message_cap():
     cfg = Config().with_overrides(max_message_size=2048)
     fe = NetworkFrontEnd(server=LocalServer(config=cfg))
     assert fe.max_message_size == 2048
+
+
+def test_storage_and_backfill_rpcs_require_tokens_too():
+    """Tenancy covers the REST-role endpoints: a tokenless connection
+    must not read a secured doc's op stream or write its storage."""
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0", "--tenant", "acme:s3cret"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    try:
+        line = proc.stdout.readline().strip()
+        port = int(line.rsplit(":", 1)[1])
+
+        bare = NetworkDocumentServiceFactory("127.0.0.1", port) \
+            .create_document_service("acme", "doc")
+        with pytest.raises(RuntimeError, match="token"):
+            bare.connect_to_delta_storage().get_deltas(0, 100)
+        with pytest.raises(RuntimeError, match="token"):
+            bare.connect_to_storage().write_blob(b"sneak")
+
+        def tokens(t, d):
+            return sign_token(t, d, "s3cret")
+
+        read_only = NetworkDocumentServiceFactory(
+            "127.0.0.1", port,
+            token_provider=lambda t, d: sign_token(
+                t, d, "s3cret", scopes=(SCOPE_READ,))) \
+            .create_document_service("acme", "doc")
+        assert read_only.connect_to_delta_storage().get_deltas(0, 10) == []
+        with pytest.raises(RuntimeError, match="scope"):
+            read_only.connect_to_storage().write_blob(b"nope")
+
+        full = NetworkDocumentServiceFactory(
+            "127.0.0.1", port, token_provider=tokens) \
+            .create_document_service("acme", "doc")
+        blob_id = full.connect_to_storage().write_blob(b"legit")
+        assert full.connect_to_storage().read_blob(blob_id) == b"legit"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
