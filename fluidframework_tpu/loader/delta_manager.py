@@ -58,6 +58,11 @@ class DeltaManager:
         # NOOP goes out. 0 disables.
         self.noop_frequency = 50
         self._remote_since_submit = 0
+        # per-client inbound pause (the OpProcessingController role,
+        # opProcessingController.ts:16): tests freeze ONE replica's
+        # delivery to force specific interleavings, then step/resume
+        self._paused = False
+        self._pause_buffer: list[SequencedDocumentMessage] = []
 
     @property
     def connected(self) -> bool:
@@ -187,9 +192,38 @@ class DeltaManager:
 
     # ------------------------------------------------------------ inbound
 
+    def pause_inbound(self) -> None:
+        """Freeze delivery to THIS replica; arriving ops buffer."""
+        self._paused = True
+
+    def resume_inbound(self) -> None:
+        """Deliver everything buffered, in order, then go live again."""
+        self._paused = False
+        pending, self._pause_buffer = self._pause_buffer, []
+        for msg in pending:
+            self._enqueue(msg)
+
+    def step_inbound(self, count: int = 1) -> int:
+        """Deliver up to ``count`` buffered messages while staying paused
+        (the process/processIncoming stepping surface). Returns how many
+        were delivered."""
+        delivered = 0
+        while delivered < count and self._pause_buffer:
+            msg = self._pause_buffer.pop(0)
+            self._paused = False
+            try:
+                self._enqueue(msg)
+            finally:
+                self._paused = True
+            delivered += 1
+        return delivered
+
     def _enqueue(self, msg: SequencedDocumentMessage) -> None:
         """Strict-order delivery with reorder buffer + gap repair
         (ref: processInboundMessage deltaManager.ts:1188)."""
+        if self._paused:
+            self._pause_buffer.append(msg)
+            return
         if msg.sequence_number <= self.last_processed_seq:
             return  # duplicate
         self._reorder[msg.sequence_number] = msg
