@@ -180,3 +180,66 @@ def test_cross_process_server():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_full_dds_catalog_over_the_wire():
+    """Breadth over the real socket stack: matrix, directory, counter,
+    consensus queue, and undo-redo all converge across two network
+    clients against a front-end process."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo",
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        port = int(line.rsplit(":", 1)[1])
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c1 = loader.resolve("t", "catalog")
+        c2 = loader.resolve("t", "catalog")
+        ds1 = c1.runtime.create_data_store("default")
+
+        matrix = ds1.create_channel("grid", "shared-matrix")
+        matrix.insert_rows(0, 2)
+        matrix.insert_cols(0, 2)
+        matrix.set_cell(0, 0, "a")
+        matrix.set_cell(1, 1, "d")
+
+        directory = ds1.create_channel("dir", "shared-directory")
+        directory.create_subdirectory("settings").set("theme", "dark")
+
+        counter = ds1.create_channel("clicks", "shared-counter")
+        counter.increment(5)
+
+        queue = ds1.create_channel("work", "consensus-queue")
+        queue.add({"job": 1})
+
+        def synced():
+            ds2 = c2.runtime.data_stores.get("default")
+            return ds2 and all(
+                ch in ds2.channels
+                for ch in ("grid", "dir", "clicks", "work"))
+        assert wait_for(synced)
+        ds2 = c2.runtime.get_data_store("default")
+        assert wait_for(lambda: ds2.get_channel("grid")
+                        .get_cell(1, 1) == "d")
+        assert ds2.get_channel("grid").get_cell(0, 0) == "a"
+        assert wait_for(lambda: ds2.get_channel("dir")
+                        .get_subdirectory("settings") is not None)
+        assert ds2.get_channel("dir").get_subdirectory("settings") \
+            .get("theme") == "dark"
+        assert wait_for(lambda: ds2.get_channel("clicks").value == 5)
+        ds2.get_channel("clicks").increment(-2)
+        assert wait_for(lambda: ds1.get_channel("clicks").value == 3)
+
+        # consensus queue: exactly-once across the wire
+        q2 = ds2.get_channel("work")
+        assert wait_for(lambda: len(q2) == 1)
+        item = q2.acquire()
+        assert item is not None
+        q2.complete(item)
+        assert wait_for(lambda: len(ds1.get_channel("work")) == 0)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
