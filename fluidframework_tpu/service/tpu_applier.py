@@ -315,6 +315,11 @@ class TpuDocumentApplier:
                 staged = self._staged[slot] = []
         table = self._client_ids.setdefault(slot, {})
         arena = self.arenas[slot]
+        # hot-loop locals: plain inserts/removes (the overwhelming bulk of
+        # real traffic) stage inline without the _stage_op dispatch
+        append = staged.append
+        arena_append = arena.append
+        table_get = table.get
         for i, (msg, wire_op) in enumerate(pairs):
             if type(wire_op) is not dict:
                 ok = False
@@ -323,10 +328,26 @@ class TpuDocumentApplier:
                 if cid is None:
                     client = SYSTEM_CLIENT
                 else:
-                    client = table.get(cid)
+                    client = table_get(cid)
                     if client is None:
                         client = len(table)
                         table[cid] = client
+                t = wire_op.get("type")
+                if t == 0 and "marker" not in wire_op \
+                        and not wire_op.get("props"):
+                    text = wire_op.get("text") or ""
+                    append((OP_INSERT, wire_op["pos"], 0,
+                            msg.sequence_number,
+                            msg.reference_sequence_number, client,
+                            len(text), arena_append(text),
+                            msg.minimum_sequence_number, 0, 0, 0))
+                    continue
+                if t == 1:
+                    append((OP_REMOVE, wire_op["start"], wire_op["end"],
+                            msg.sequence_number,
+                            msg.reference_sequence_number, client, 0, 0,
+                            msg.minimum_sequence_number, 0, 0, 0))
+                    continue
                 ok = self._stage_op(
                     staged, arena, wire_op, msg.sequence_number,
                     msg.reference_sequence_number, client,
