@@ -206,10 +206,16 @@ class DeltaManager:
     def step_inbound(self, count: int = 1) -> int:
         """Deliver up to ``count`` buffered messages while staying paused
         (the process/processIncoming stepping surface). Returns how many
-        were delivered."""
+        were delivered.
+
+        Steps in SEQUENCE order, not arrival order: stepping an
+        out-of-order arrival would trigger gap repair that pulls ops
+        still sitting in the pause buffer from delta storage — delivering
+        more than ``count`` and leaving silent duplicates behind."""
         delivered = 0
         while delivered < count and self._pause_buffer:
-            msg = self._pause_buffer.pop(0)
+            msg = min(self._pause_buffer, key=lambda m: m.sequence_number)
+            self._pause_buffer.remove(msg)
             self._paused = False
             try:
                 self._enqueue(msg)
