@@ -259,6 +259,10 @@ def main() -> None:
                 "value": service["ops_per_sec"],
                 "unit": "ops/s",
                 "vs_baseline": round(service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
+                # ack latency AT the headline load (submit → own
+                # broadcast, per boxcar): the north star's "p99 < 50 ms
+                # at >= 50k ops/s" measured on one path simultaneously
+                "p99_ack_ms_at_load": service["p99_ack_ms"],
                 # Pallas VMEM-resident kernel; the XLA scan for comparison
                 "kernel_ops_per_sec": round(kernel_ops, 1),
                 "kernel_xla_ops_per_sec": round(kernel_xla_ops, 1),

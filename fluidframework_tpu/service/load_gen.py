@@ -212,6 +212,7 @@ def run_inproc(
         wire_applier(server, applier, tenant, docs)
 
     sessions = []  # (conn, editor)
+    submit_t = [0.0]  # the in-flight boxcar's submit timestamp
     for doc in docs:
         for _ in range(clients_per_doc):
             conn = server.connect(tenant, doc)
@@ -225,6 +226,12 @@ def run_inproc(
                         acked += 1
                     else:
                         editor.observe(msg)
+                if acked:
+                    # submit → own-broadcast latency for this boxcar (the
+                    # in-proc ack time; ONE sample per boxcar — samples
+                    # per op would be identical copies)
+                    stats.ack_latencies_ms.append(
+                        (time.perf_counter() - submit_t[0]) * 1e3)
                 stats.ops_acked += acked
             conn.on_ops = on_ops
             sessions.append((conn, editor))
@@ -236,6 +243,7 @@ def run_inproc(
     t0 = time.perf_counter()
     for i in range(rounds):
         for conn, editor in sessions:
+            submit_t[0] = time.perf_counter()
             conn.submit(editor.next_ops(batch_size))
             stats.ops_submitted += batch_size
             since_flush += batch_size
