@@ -37,6 +37,11 @@ class Config:
     summary_max_ops: int = 100           # ops since last ack → attempt
     # ---- DDS: merge-tree snapshot chunking (ref: snapshotV1.ts:87)
     summary_chunk_segments: int = 256    # segments per summary chunk blob
+    # ---- service: log retention margin kept BELOW an acked summary's
+    # capture seq (ops older than that truncate from scriptorium; a
+    # client disconnected past the window reloads from the summary).
+    # Negative disables truncation entirely.
+    log_retention_ops: int = 1000
     # ---- service: GC posture for long-lived service processes
     gc_gen0_threshold: int = 200_000
 

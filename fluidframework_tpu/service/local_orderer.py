@@ -53,6 +53,7 @@ class LocalOrderer:
         clock: Callable[[], float] = time.time,
         client_timeout: Optional[float] = None,
         logger=None,
+        log_retention_ops: Optional[int] = None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
@@ -96,12 +97,22 @@ class LocalOrderer:
         scribe_cp = db.find_one(
             SCRIBE_CHECKPOINT_COLLECTION, f"{tenant_id}/{document_id}")
         scribe_state = scribe_log_cp or (scribe_cp["state"] if scribe_cp else None)
+        on_committed = None
+        if log_retention_ops is not None and log_retention_ops >= 0:
+            retention = log_retention_ops
+
+            def on_committed(capture_seq: int) -> None:
+                # ops the acked summary covers truncate, minus a margin
+                # for in-flight backfills (config.log_retention_ops)
+                self.scriptorium.truncate_below(
+                    tenant_id, document_id, capture_seq - retention)
         self.scribe = ScribeLambda(
             tenant_id,
             document_id,
             db,
             send_to_deli=self.order,
             checkpoint=scribe_state,
+            on_summary_committed=on_committed,
         )
 
         # deli replays the raw topic from 0 and self-skips via its

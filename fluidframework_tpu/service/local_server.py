@@ -273,9 +273,12 @@ class LocalServer:
             kw = {}
             if self._client_timeout is not None:
                 kw["client_timeout"] = self._client_timeout
+            retention = self.config.log_retention_ops
             self._orderers[key] = LocalOrderer(
                 tenant_id, document_id, self.log, self.db, self.pubsub,
-                clock=self._clock, logger=self.logger, **kw)
+                clock=self._clock, logger=self.logger,
+                log_retention_ops=retention if retention >= 0 else None,
+                **kw)
         return self._orderers[key]
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:

@@ -39,11 +39,15 @@ class ScribeLambda:
         db: InMemoryDb,
         send_to_deli: Callable[[RawMessage], None],
         checkpoint: Optional[dict] = None,
+        on_summary_committed: Optional[Callable[[int], None]] = None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
         self._db = db
         self._send_to_deli = send_to_deli
+        # fires with the committed summary's capture seq — the hook log
+        # retention hangs off (ops the summary covers may truncate)
+        self._on_committed = on_summary_committed
         self._versions_col = summary_versions_collection(tenant_id, document_id)
         if checkpoint:
             self.protocol = ProtocolOpHandler.load(checkpoint["protocol"])
@@ -114,6 +118,8 @@ class ScribeLambda:
         # commit: mark the version acked (the git ref update analog)
         self._db.upsert(self._versions_col, handle, dict(version, acked=True))
         self.last_summary_head = handle
+        if self._on_committed is not None:
+            self._on_committed(head)
         self._send_to_deli(
             RawMessage(
                 tenant_id=self.tenant_id,

@@ -14,10 +14,18 @@ from .core import InMemoryDb, QueuedMessage
 
 class ScriptoriumLambda:
     """Stores each doc's sequenced stream as ONE db document holding the
-    seq-ordered list (``log[i]`` is seq ``i+1`` — the sequencer assigns
-    dense seqs from 1, so the list IS the index). Appends are O(batch)
-    and range reads are slices; the round-2 per-op keyed upserts were a
-    measurable slice of the service hot path."""
+    seq-ordered list (``log[i]`` is seq ``i+1+base`` — the sequencer
+    assigns dense seqs from 1, so list position IS the index, offset by
+    the truncation ``base``). Appends are O(batch) and range reads are
+    slices; the round-2 per-op keyed upserts were a measurable slice of
+    the service hot path.
+
+    Retention: once a summary is ACKED at seq N, ops ≤ N are only needed
+    by replicas that already hold them — new boots use the summary + the
+    tail. ``truncate_below`` drops the covered prefix (keeping a safety
+    margin for in-flight backfills); a client disconnected past the
+    retained window must reload from the summary, the same contract as
+    the reference's deli ClearCache + summary-based catch-up."""
 
     def __init__(self, db: InMemoryDb):
         self._db = db
@@ -26,12 +34,15 @@ class ScriptoriumLambda:
     def collection(tenant_id: str, document_id: str) -> str:
         return f"deltas/{tenant_id}/{document_id}"
 
-    def _log(self, name: str) -> list:
+    def _doc(self, name: str) -> dict:
         col = self._db.collection(name)
         doc = col.get("log")
         if doc is None:
-            doc = col["log"] = {"_id": "log", "messages": []}
-        return doc["messages"]
+            doc = col["log"] = {"_id": "log", "messages": [], "base": 0}
+        return doc
+
+    def _log(self, name: str) -> list:
+        return self._doc(name)["messages"]
 
     def handler(self, message: QueuedMessage) -> None:
         envelope = message.value
@@ -39,8 +50,9 @@ class ScriptoriumLambda:
         batch = envelope.get("boxcar")
         if batch is None:
             batch = [envelope["message"]]
-        log = self._log(name)
-        last = log[-1].sequence_number if log else 0
+        doc = self._doc(name)
+        log = doc["messages"]
+        last = log[-1].sequence_number if log else doc.get("base", 0)
         first = batch[0].sequence_number
         if first == last + 1:  # the hot path: append in arrival order
             log.extend(batch)
@@ -55,12 +67,31 @@ class ScriptoriumLambda:
     def close(self) -> None:
         pass
 
+    def truncate_below(self, tenant_id: str, document_id: str,
+                       seq: int) -> int:
+        """Drop retained ops with sequence_number ≤ seq; returns how many
+        were dropped. Callers pass (acked summary seq − retention)."""
+        doc = self._doc(self.collection(tenant_id, document_id))
+        base = doc.get("base", 0)
+        drop = min(max(seq - base, 0), len(doc["messages"]))
+        if drop > 0:
+            del doc["messages"][:drop]
+            doc["base"] = base + drop
+        return drop
+
+    def retained_base(self, tenant_id: str, document_id: str) -> int:
+        """Seqs ≤ base are no longer served (summary-covered)."""
+        return self._doc(self.collection(tenant_id, document_id)) \
+            .get("base", 0)
+
     def get_deltas(
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
     ) -> list[SequencedDocumentMessage]:
         """Ops with from_seq < seq < to_seq (exclusive bounds, matching the
-        reference's /deltas REST contract)."""
-        log = self._log(self.collection(tenant_id, document_id))
-        lo = max(from_seq, 0)
-        hi = min(to_seq - 1, len(log))
+        reference's /deltas REST contract); truncated prefix excluded."""
+        doc = self._doc(self.collection(tenant_id, document_id))
+        base = doc.get("base", 0)
+        log = doc["messages"]
+        lo = max(from_seq - base, 0)
+        hi = min(to_seq - 1 - base, len(log))
         return log[lo:hi] if hi > lo else []

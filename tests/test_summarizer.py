@@ -237,3 +237,45 @@ def test_scribe_skips_duplicate_summarize_at_new_offset():
     scribe.handler(QueuedMessage(topic="deltas/t/d", partition=0, offset=1, value={"message": summarize}))
     assert [m.operation.type for m in sent] == [MessageType.SUMMARY_ACK]
     assert scribe.last_summary_head == "h1"
+
+
+def test_log_truncates_behind_acked_summaries(server):
+    """Retention: ops an acked summary covers truncate from scriptorium
+    (minus the configured margin); fresh clients still boot correctly
+    from summary + retained tail."""
+    from fluidframework_tpu.config import Config
+    from fluidframework_tpu.service import LocalServer
+
+    srv = LocalServer(config=Config().with_overrides(log_retention_ops=5))
+    loader = Loader(LocalDocumentServiceFactory(srv))
+    c1 = loader.resolve("t", "doc")
+    sm = SummaryManager(c1, max_ops=10**9)
+    s = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(30):
+        s.insert_text(0, f"{i % 10}")
+    sm.summarize_now()
+    assert sm.summaries_acked == 1
+
+    orderer = srv._get_orderer("t", "doc")
+    base = orderer.scriptorium.retained_base("t", "doc")
+    assert base > 0  # prefix dropped
+    # the margin holds: at least the last 5 pre-summary ops are retained
+    head = orderer.deli.sequence_number
+    assert head - base >= 5
+    # nothing below the base is served
+    assert all(m.sequence_number > base
+               for m in srv.get_deltas("t", "doc", 0, 10**9))
+
+    # fresh boots use the summary + retained tail and stay live
+    c2 = loader.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == s.get_text()
+    s2.insert_text(0, "x")
+    assert s.get_text() == s2.get_text()
+
+    # a second cycle truncates further
+    for i in range(10):
+        s.insert_text(0, "y")
+    sm.summarize_now()
+    assert orderer.scriptorium.retained_base("t", "doc") > base
