@@ -130,7 +130,8 @@ class SharedMatrix(SharedObject):
         wire = {"op": kind, "pos": pos, "count": count}
         self._pending.append({"kind": "vector", "wire": wire})
         self.submit_local_message(wire)
-        self._emit("shapeChanged", {"op": kind, "local": True})
+        self._emit("shapeChanged", {"op": kind, "pos": pos, "count": count,
+                                    "local": True})
 
     def _remove_vector(self, vec: PermutationVector, kind: str, pos: int, count: int) -> None:
         handles = [vec.handle_at(p) for p in range(pos, pos + count)]
@@ -155,12 +156,14 @@ class SharedMatrix(SharedObject):
     def set_cell(self, row: int, col: int, value: Any) -> None:
         rh = self.rows.handle_at(row)
         ch = self.cols.handle_at(col)
+        prev = self._cells.get((rh, ch))
         self._cells[(rh, ch)] = value
         self._pending_cells[(rh, ch)] = self._pending_cells.get((rh, ch), 0) + 1
         wire = {"op": "setCell", "row": row, "col": col, "value": value}
         self._pending.append({"kind": "cell", "rh": rh, "ch": ch, "wire": wire})
         self.submit_local_message(wire)
-        self._emit("cellChanged", {"row": row, "col": col, "local": True})
+        self._emit("cellChanged", {"row": row, "col": col, "local": True,
+                                   "previousValue": prev})
 
     def get_cell(self, row: int, col: int) -> Any:
         rh = self.rows.handle_at(row)

@@ -103,6 +103,25 @@ class UndoRedoStackManager:
     def attach_string(self, s: SharedString) -> None:
         s.on("sequenceDelta", lambda e: self._on_string_event(s, e))
 
+    def attach_matrix(self, m) -> None:
+        """Cell sets and row/col INSERTS are undoable; removals are not
+        (purged cells cannot be revived — see _VectorInsertRevertible)."""
+        m.on("cellChanged", lambda e: self._on_matrix_cell(m, e))
+        m.on("shapeChanged", lambda e: self._on_matrix_shape(m, e))
+
+    def _on_matrix_cell(self, m, event: dict) -> None:
+        if event.get("local"):
+            self._capture(_CellRevertible(
+                m, event["row"], event["col"], event.get("previousValue")))
+
+    def _on_matrix_shape(self, m, event: dict) -> None:
+        if not event.get("local"):
+            return
+        op = event.get("op", "")
+        if op in ("insertRows", "insertCols") and "pos" in event:
+            self._capture(_VectorInsertRevertible(
+                m, op == "insertRows", event["pos"], event["count"]))
+
     def _on_map_event(self, m: SharedMap, event: dict) -> None:
         if event.get("local"):
             self._capture(_MapRevertible(
@@ -167,3 +186,31 @@ class UndoRedoStackManager:
         self._revert_group(group, inverse)
         self._undo.append(inverse)
         return True
+
+
+class _CellRevertible:
+    """Undo a setCell by rewriting the previous LWW value (ref: matrix
+    undoprovider.ts cell tracking)."""
+
+    def __init__(self, m, row: int, col: int, prev_value):
+        self.m, self.row, self.col, self.prev = m, row, col, prev_value
+
+    def revert(self) -> None:
+        self.m.set_cell(self.row, self.col, self.prev)
+
+
+class _VectorInsertRevertible:
+    """Undo an insertRows/insertCols by removing the inserted span (ref:
+    matrix undoprovider.ts VectorUndoProvider). Row/col REMOVALS are not
+    undoable here: the cells of removed axes are purged with their
+    handles, so there is no content to revive — attach_matrix documents
+    the scope."""
+
+    def __init__(self, m, is_rows: bool, pos: int, count: int):
+        self.m, self.is_rows, self.pos, self.count = m, is_rows, pos, count
+
+    def revert(self) -> None:
+        if self.is_rows:
+            self.m.remove_rows(self.pos, self.count)
+        else:
+            self.m.remove_cols(self.pos, self.count)

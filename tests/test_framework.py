@@ -159,3 +159,36 @@ def test_number_and_object_sequences(loader):
     assert nums.get_items() == [10, 2, 3]
     assert nums.get_item(1) == 2
     assert ds2.get_channel("objs").get_items() == [{"a": 1}, {"b": 2}]
+
+
+def test_matrix_undo_redo(loader):
+    """Matrix undo: cell LWW reverts and inserted rows/cols retract
+    (VectorUndoProvider scope: removals are not undoable)."""
+    from fluidframework_tpu.framework.undo_redo import UndoRedoStackManager
+
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    m = c1.runtime.create_data_store("default").create_channel(
+        "grid", "shared-matrix")
+    m.insert_rows(0, 2)
+    m.insert_cols(0, 2)
+    m.set_cell(0, 0, "keep")
+
+    mgr = UndoRedoStackManager()
+    mgr.attach_matrix(m)
+
+    m.set_cell(0, 0, "edited")
+    mgr.close_current_operation()
+    m.insert_rows(2, 1)
+    m.set_cell(2, 1, "new-row-cell")
+    mgr.close_current_operation()
+
+    m2 = c2.runtime.get_data_store("default").get_channel("grid")
+    assert m2.row_count == 3 and m2.get_cell(2, 1) == "new-row-cell"
+
+    assert mgr.undo()  # retract the row insert (incl. its cell edit)
+    assert m.row_count == 2 and m2.row_count == 2
+    assert mgr.undo()  # revert the cell edit
+    assert m.get_cell(0, 0) == "keep" and m2.get_cell(0, 0) == "keep"
+    assert mgr.redo()
+    assert m.get_cell(0, 0) == "edited" and m2.get_cell(0, 0) == "edited"
