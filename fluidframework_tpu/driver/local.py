@@ -27,6 +27,7 @@ class LocalDeltaConnection(DocumentDeltaConnection):
         self._conn = conn
         self.client_id = conn.client_id
         self.initial_sequence_number = conn.initial_sequence_number
+        self.mode = getattr(conn, "mode", "write")
         self.on_disconnect = None
 
     # event callbacks proxy straight to the server connection's buffered

@@ -166,6 +166,7 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             "details": details, "token": token})
         self.client_id = reply["clientId"]
         self.initial_sequence_number = reply["seq"]
+        self.mode = reply.get("mode", "write")
         self.max_message_size = reply.get("maxMessageSize")
 
     def _deliver(self, kind: str, event) -> None:

@@ -92,6 +92,12 @@ class DeltaManager:
         # repair any gap between our head and the pre-subscription history;
         # everything from the handshake on arrives live (incl. our join)
         self._fetch_missing(upto=conn.initial_sequence_number)
+        if getattr(conn, "mode", "write") == "read":
+            # read connections never join the quorum, so there is no join
+            # round-trip to wait for: they go active immediately (and the
+            # write path below refuses their submissions)
+            if self._pending_connection is conn:
+                self._activate_connection()
         return conn.client_id
 
     def _activate_connection(self) -> None:
@@ -138,6 +144,9 @@ class DeltaManager:
         """Send one message on the live connection; returns clientSeq."""
         if self.connection is None:
             raise RuntimeError("cannot submit while disconnected")
+        if getattr(self.connection, "mode", "write") == "read":
+            raise PermissionError(
+                "read connection: this client's token lacks doc:write")
         self._remote_since_submit = 0
         self._client_seq += 1
         self.connection.submit(
@@ -247,6 +256,7 @@ class DeltaManager:
         if (
             self.noop_frequency
             and self.connection is not None
+            and getattr(self.connection, "mode", "write") != "read"
             and self._remote_since_submit >= self.noop_frequency
         ):
             self._remote_since_submit = 0

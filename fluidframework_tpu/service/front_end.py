@@ -197,6 +197,7 @@ class _ClientSession:
                     "rid": rid,
                     "clientId": conn.client_id,
                     "seq": conn.initial_sequence_number,
+                    "mode": getattr(conn, "mode", "write"),
                     "maxMessageSize": self.front.max_message_size,
                 })
             elif t == "submit":
@@ -303,6 +304,7 @@ class _ClientSession:
                 "rid": rid, "sid": sid,
                 "clientId": conn.client_id,
                 "seq": conn.initial_sequence_number,
+                "mode": getattr(conn, "mode", "write"),
                 "maxMessageSize": self.front.max_message_size,
             })
         elif t == "fsubmit":

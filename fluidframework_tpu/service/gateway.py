@@ -73,6 +73,7 @@ class _GatewaySession:
                 "token": frame.get("token")})
             self.push({"t": "connected", "rid": frame.get("rid"),
                        "clientId": reply["clientId"], "seq": reply["seq"],
+                       "mode": reply.get("mode", "write"),
                        "maxMessageSize": reply.get("maxMessageSize")})
         elif t == "submit":
             # ops pass through verbatim — no payload re-encode

@@ -201,6 +201,7 @@ class LocalServer:
         client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
         conn.can_write = can_write
+        conn.mode = "write" if can_write else "read"
 
         topic = BroadcasterLambda.topic(tenant_id, document_id)
         conn._op_cb = conn._deliver_ops  # op topics carry batches
@@ -212,24 +213,30 @@ class LocalServer:
         self.pubsub.subscribe(f"signal/{tenant_id}/{document_id}", conn._sig_cb)
 
         conn.initial_sequence_number = orderer.deli.sequence_number
-        orderer.order(
-            RawMessage(
-                tenant_id=tenant_id,
-                document_id=document_id,
-                client_id=None,
-                operation=DocumentMessage(
-                    client_sequence_number=-1,
-                    reference_sequence_number=-1,
-                    type=MessageType.CLIENT_JOIN,
-                    contents={
-                        "clientId": client_id,
-                        "detail": details,
-                        "canEvict": can_evict,
-                    },
-                ),
-                timestamp=self._clock(),
+        if can_write:
+            orderer.order(
+                RawMessage(
+                    tenant_id=tenant_id,
+                    document_id=document_id,
+                    client_id=None,
+                    operation=DocumentMessage(
+                        client_sequence_number=-1,
+                        reference_sequence_number=-1,
+                        type=MessageType.CLIENT_JOIN,
+                        contents={
+                            "clientId": client_id,
+                            "detail": details,
+                            "canEvict": can_evict,
+                        },
+                    ),
+                    timestamp=self._clock(),
+                )
             )
-        )
+        # read connections NEVER join: they are not quorum members and
+        # must not contribute to the msn — a reader cannot submit (its
+        # ops scope-nack), so a joined reader would pin the collaboration
+        # window forever (ref: read connections stay out of the quorum;
+        # they exist only in the audience)
         self._maybe_drain()
         return conn
 
@@ -313,6 +320,10 @@ class LocalServer:
             f"signal/{conn.tenant_id}/{conn.document_id}", signal)
 
     def _disconnect(self, conn: ServerConnection) -> None:
+        if not getattr(conn, "can_write", True):
+            # read connections never joined: nothing to leave
+            self._unsubscribe_conn(conn)
+            return
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)
         orderer.order(
             RawMessage(
@@ -328,6 +339,10 @@ class LocalServer:
                 timestamp=self._clock(),
             )
         )
+        self._unsubscribe_conn(conn)
+        self._maybe_drain()
+
+    def _unsubscribe_conn(self, conn: ServerConnection) -> None:
         topic = BroadcasterLambda.topic(conn.tenant_id, conn.document_id)
         self.pubsub.unsubscribe(topic, conn._op_cb)
         self.pubsub.unsubscribe(
@@ -335,7 +350,6 @@ class LocalServer:
             conn._nack_cb)
         self.pubsub.unsubscribe(
             f"signal/{conn.tenant_id}/{conn.document_id}", conn._sig_cb)
-        self._maybe_drain()
 
     def _maybe_drain(self) -> None:
         if self._auto_drain:

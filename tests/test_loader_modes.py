@@ -92,6 +92,39 @@ def test_readonly_replica_keeps_receiving_remote_ops(server, loader):
     assert s.get_text() == "again remote editable"
 
 
+def test_read_connection_stays_out_of_quorum_and_msn():
+    """A read connection must not pin the collaboration window: it never
+    joins the quorum, so the msn advances without it (ref: read
+    connections live in the audience only)."""
+    tm = TenantManager()
+    tm.register("acme", "s3cret")
+    server = LocalServer(tenants=tm)
+    w = server.connect("acme", "doc",
+                       token=sign_token("acme", "doc", "s3cret"))
+    r = server.connect(
+        "acme", "doc",
+        token=sign_token("acme", "doc", "s3cret", scopes=(SCOPE_READ,)))
+    assert r.mode == "read"
+    deli = server._get_orderer("acme", "doc").deli
+    assert r.client_id not in deli.clients  # not a quorum member
+
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    seen = []
+    r.on_ops = lambda batch: seen.extend(batch)
+    for i in range(1, 6):
+        w.submit([DocumentMessage(
+            client_sequence_number=i, reference_sequence_number=i,
+            type=MessageType.OPERATION, contents={"i": i})])
+    # the msn tracks the WRITER alone — the silent reader doesn't pin it
+    assert deli._min_ref_seq() >= 5
+    assert len([m for m in seen if m.type.value == "op"]) == 5  # reads live
+    r.disconnect()  # no leave op needed; nothing joined
+
+
 def test_read_scope_connection_watches_but_cannot_write():
     tm = TenantManager()
     tm.register("acme", "s3cret")
