@@ -130,8 +130,12 @@ class SharedMatrix(SharedObject):
         wire = {"op": kind, "pos": pos, "count": count}
         self._pending.append({"kind": "vector", "wire": wire})
         self.submit_local_message(wire)
-        self._emit("shapeChanged", {"op": kind, "pos": pos, "count": count,
-                                    "local": True})
+        self._emit("shapeChanged", {
+            "op": kind, "pos": pos, "count": count, "local": True,
+            # stable handles of the inserted span: undo anchors on these,
+            # not on positions that concurrent remote edits can shift
+            "handles": [vec.handle_at(p) for p in range(pos, pos + count)],
+        })
 
     def _remove_vector(self, vec: PermutationVector, kind: str, pos: int, count: int) -> None:
         handles = [vec.handle_at(p) for p in range(pos, pos + count)]
@@ -163,7 +167,17 @@ class SharedMatrix(SharedObject):
         self._pending.append({"kind": "cell", "rh": rh, "ch": ch, "wire": wire})
         self.submit_local_message(wire)
         self._emit("cellChanged", {"row": row, "col": col, "local": True,
+                                   "rowHandle": rh, "colHandle": ch,
                                    "previousValue": prev})
+
+    def position_of_handles(self, row_handle: int, col_handle: int):
+        """Current (row, col) of a stable handle pair, or None when
+        either axis was removed — the undo anchor resolution."""
+        row = self.rows.position_of_handle(row_handle)
+        col = self.cols.position_of_handle(col_handle)
+        if row is None or col is None:
+            return None
+        return row, col
 
     def get_cell(self, row: int, col: int) -> Any:
         rh = self.rows.handle_at(row)

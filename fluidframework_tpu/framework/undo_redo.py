@@ -110,17 +110,18 @@ class UndoRedoStackManager:
         m.on("shapeChanged", lambda e: self._on_matrix_shape(m, e))
 
     def _on_matrix_cell(self, m, event: dict) -> None:
-        if event.get("local"):
+        if event.get("local") and "rowHandle" in event:
             self._capture(_CellRevertible(
-                m, event["row"], event["col"], event.get("previousValue")))
+                m, event["rowHandle"], event["colHandle"],
+                event.get("previousValue")))
 
     def _on_matrix_shape(self, m, event: dict) -> None:
         if not event.get("local"):
             return
         op = event.get("op", "")
-        if op in ("insertRows", "insertCols") and "pos" in event:
+        if op in ("insertRows", "insertCols") and event.get("handles"):
             self._capture(_VectorInsertRevertible(
-                m, op == "insertRows", event["pos"], event["count"]))
+                m, op == "insertRows", event["handles"]))
 
     def _on_map_event(self, m: SharedMap, event: dict) -> None:
         if event.get("local"):
@@ -189,28 +190,42 @@ class UndoRedoStackManager:
 
 
 class _CellRevertible:
-    """Undo a setCell by rewriting the previous LWW value (ref: matrix
-    undoprovider.ts cell tracking)."""
+    """Undo a setCell by rewriting the previous LWW value, anchored on
+    the cell's STABLE handles — concurrent remote row/col inserts shift
+    positions, so a position-addressed revert would clobber the wrong
+    cell (ref: matrix undoprovider.ts tracks handles for the same
+    reason)."""
 
-    def __init__(self, m, row: int, col: int, prev_value):
-        self.m, self.row, self.col, self.prev = m, row, col, prev_value
+    def __init__(self, m, row_handle: int, col_handle: int, prev_value):
+        self.m, self.rh, self.ch, self.prev = m, row_handle, col_handle, \
+            prev_value
 
     def revert(self) -> None:
-        self.m.set_cell(self.row, self.col, self.prev)
+        at = self.m.position_of_handles(self.rh, self.ch)
+        if at is None:
+            return  # the cell's row/col was removed meanwhile: no-op
+        self.m.set_cell(at[0], at[1], self.prev)
 
 
 class _VectorInsertRevertible:
-    """Undo an insertRows/insertCols by removing the inserted span (ref:
-    matrix undoprovider.ts VectorUndoProvider). Row/col REMOVALS are not
-    undoable here: the cells of removed axes are purged with their
-    handles, so there is no content to revive — attach_matrix documents
-    the scope."""
+    """Undo an insertRows/insertCols by removing the inserted span,
+    resolved through the inserted HANDLES at revert time (the span may
+    have moved or been interleaved by remote inserts). Row/col REMOVALS
+    are not undoable here: the cells of removed axes are purged with
+    their handles, so there is no content to revive — attach_matrix
+    documents the scope."""
 
-    def __init__(self, m, is_rows: bool, pos: int, count: int):
-        self.m, self.is_rows, self.pos, self.count = m, is_rows, pos, count
+    def __init__(self, m, is_rows: bool, handles: list):
+        self.m, self.is_rows, self.handles = m, is_rows, list(handles)
 
     def revert(self) -> None:
-        if self.is_rows:
-            self.m.remove_rows(self.pos, self.count)
-        else:
-            self.m.remove_cols(self.pos, self.count)
+        vec = self.m.rows if self.is_rows else self.m.cols
+        positions = sorted(
+            (p for p in (vec.position_of_handle(h) for h in self.handles)
+             if p is not None),
+            reverse=True)  # highest first: removals don't shift the rest
+        for p in positions:
+            if self.is_rows:
+                self.m.remove_rows(p, 1)
+            else:
+                self.m.remove_cols(p, 1)

@@ -12,6 +12,18 @@ from ..protocol.messages import SequencedDocumentMessage
 from .core import InMemoryDb, QueuedMessage
 
 
+class LogTruncatedError(RuntimeError):
+    """The requested range starts below the retention base: the caller's
+    head predates the truncated prefix and it must reload from the
+    latest acked summary instead of backfilling op-by-op."""
+
+    def __init__(self, base: int):
+        super().__init__(
+            f"op log truncated below seq {base}: reload from the latest "
+            "acked summary")
+        self.base = base
+
+
 class ScriptoriumLambda:
     """Stores each doc's sequenced stream as ONE db document holding the
     seq-ordered list (``log[i]`` is seq ``i+1+base`` — the sequencer
@@ -88,9 +100,14 @@ class ScriptoriumLambda:
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
     ) -> list[SequencedDocumentMessage]:
         """Ops with from_seq < seq < to_seq (exclusive bounds, matching the
-        reference's /deltas REST contract); truncated prefix excluded."""
+        reference's /deltas REST contract). A request reaching below the
+        retention base raises :class:`LogTruncatedError` — silently
+        omitting the dropped prefix would stall the caller forever on a
+        gap that can never fill."""
         doc = self._doc(self.collection(tenant_id, document_id))
         base = doc.get("base", 0)
+        if from_seq < base:
+            raise LogTruncatedError(base)
         log = doc["messages"]
         lo = max(from_seq - base, 0)
         hi = min(to_seq - 1 - base, len(log))

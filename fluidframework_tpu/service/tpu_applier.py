@@ -133,17 +133,16 @@ def channel_stream(server, tenant_id: str, document_id: str,
     sequenced op log (scriptorium) — the applier's replay source and the
     scribe-replay entry point (BASELINE config 5).
 
-    Refuses truncated logs: with log retention active, a from-zero
-    replay would silently rebuild WRONG state once ops behind an acked
-    summary have been dropped — such deployments must give the applier a
-    summary-aware replay source instead."""
-    base = server._get_orderer(tenant_id, document_id) \
-        .scriptorium.retained_base(tenant_id, document_id)
-    if base > 0:
-        raise RuntimeError(
-            f"{tenant_id}/{document_id}: log truncated below seq {base}; "
-            "from-zero replay is unsound — replay from the acked summary")
-    for m in server.get_deltas(tenant_id, document_id, 0, 10**9):
+    Truncated logs raise (scriptorium.LogTruncatedError): with log
+    retention active, a from-zero replay would silently rebuild WRONG
+    state once ops behind an acked summary have been dropped — such
+    deployments must give the applier a summary-aware replay source.
+    Reads go straight through a stateless ScriptoriumLambda over the db
+    so inspecting a doc never lazily constructs its whole pipeline."""
+    from .scriptorium import ScriptoriumLambda
+
+    for m in ScriptoriumLambda(server.db).get_deltas(
+            tenant_id, document_id, 0, 10**9):
         if m.type != MessageType.OPERATION:
             continue
         env = m.contents

@@ -239,7 +239,7 @@ def test_scribe_skips_duplicate_summarize_at_new_offset():
     assert scribe.last_summary_head == "h1"
 
 
-def test_log_truncates_behind_acked_summaries(server):
+def test_log_truncates_behind_acked_summaries():
     """Retention: ops an acked summary covers truncate from scriptorium
     (minus the configured margin); fresh clients still boot correctly
     from summary + retained tail."""
@@ -263,9 +263,14 @@ def test_log_truncates_behind_acked_summaries(server):
     # the margin holds: at least the last 5 pre-summary ops are retained
     head = orderer.deli.sequence_number
     assert head - base >= 5
-    # nothing below the base is served
+    # a fetch reaching below the base fails LOUDLY (a silent gap would
+    # stall the caller forever); from the base upward it serves normally
+    from fluidframework_tpu.service.scriptorium import LogTruncatedError
+
+    with pytest.raises(LogTruncatedError):
+        srv.get_deltas("t", "doc", 0, 10**9)
     assert all(m.sequence_number > base
-               for m in srv.get_deltas("t", "doc", 0, 10**9))
+               for m in srv.get_deltas("t", "doc", base, 10**9))
 
     # fresh boots use the summary + retained tail and stay live
     c2 = loader.resolve("t", "doc")
