@@ -78,7 +78,8 @@ _DENSE_STEP_CACHE: dict = {}
 _PACK_SYSTEM = np.int16(32767)
 
 
-def _dense_step_for(D: int, K: int):
+def _dense_step_for(D: int, K: int, use_pallas: bool = False,
+                    pallas_interpret: bool = False):
     """The wave arrives PACKED from the host: int16[D, K, F] deltas plus
     int32[D, 2] per-doc bases (seq, text_start), unpacked to the kernel's
     int32 field layout on device with elementwise math.
@@ -94,8 +95,17 @@ def _dense_step_for(D: int, K: int):
     window. The host checks the ranges and falls back to the int32 wave
     when any field escapes (huge docs, giant windows).
     """
-    fn = _DENSE_STEP_CACHE.get((D, K))
+    fn = _DENSE_STEP_CACHE.get((D, K, use_pallas, pallas_interpret))
     if fn is None:
+        if use_pallas:
+            from ..ops.pallas_apply import pallas_apply_ops_batch
+
+            def apply_fn(state, wave):
+                return pallas_apply_ops_batch(
+                    state, wave, interpret=pallas_interpret)
+        else:
+            apply_fn = apply_ops_batch
+
         def unpack(wave16, bases):
             w = wave16.astype(jnp.int32)
             typ = w[..., F_TYPE]
@@ -114,16 +124,16 @@ def _dense_step_for(D: int, K: int):
 
         def dense_step(state, wave16, bases):
             wave = unpack(wave16, bases)
-            state = apply_ops_batch(state, wave)
+            state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
         def dense_step_wide(state, wave):
-            state = apply_ops_batch(state, wave)
+            state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
         fn = (jax.jit(dense_step, donate_argnums=(0,)),
               jax.jit(dense_step_wide, donate_argnums=(0,)))
-        _DENSE_STEP_CACHE[(D, K)] = fn
+        _DENSE_STEP_CACHE[(D, K, use_pallas, pallas_interpret)] = fn
     return fn
 
 
@@ -168,6 +178,8 @@ class TpuDocumentApplier:
         overflow_check_every: Optional[int] = None,
         async_dispatch: bool = False,
         min_wave_ops: Optional[int] = None,
+        use_pallas: Optional[bool] = None,
+        pallas_interpret: bool = False,
     ):
         from ..config import DEFAULT as _CFG
 
@@ -234,7 +246,15 @@ class TpuDocumentApplier:
             # dense dispatch: ship the padded [D, K, F] wave packed to
             # int16 deltas (see _dense_step_for for the wire format and
             # why device-side scatter lost)
-            self._dense_step = _dense_step_for(max_docs, self.K)
+            use_pallas = (use_pallas if use_pallas is not None
+                          else _CFG.applier_use_pallas)
+            if use_pallas and max_docs % 8:
+                raise ValueError(
+                    "applier_use_pallas requires max_docs % 8 == 0 "
+                    f"(got {max_docs})")
+            self._dense_step = _dense_step_for(
+                max_docs, self.K, use_pallas=use_pallas,
+                pallas_interpret=pallas_interpret)
         self.dispatches = 0
         self.ops_applied = 0
         self.host_escalations = 0

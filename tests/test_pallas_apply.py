@@ -54,3 +54,39 @@ def test_pallas_flags_overflow_identically():
     assert np.asarray(ref.overflow).any()  # the stream really overflows
     np.testing.assert_array_equal(
         np.asarray(got.overflow), np.asarray(ref.overflow))
+
+
+def test_applier_with_pallas_dense_step_matches_live_clients():
+    """The live TpuDocumentApplier with use_pallas rides the same
+    sequenced stream as real clients and converges identically
+    (interpret mode on the CPU test mesh)."""
+    from fluidframework_tpu.driver import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalServer
+    from fluidframework_tpu.service.tpu_applier import (
+        TpuDocumentApplier,
+        channel_stream,
+    )
+
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "pdoc")
+    c2 = loader.resolve("t", "pdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "pallas in the loop")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(0, ">> ")
+    s1.remove_text(3, 10)
+    s1.annotate_range(0, 4, {"bold": True})
+    assert s1.get_text() == s2.get_text()
+
+    applier = TpuDocumentApplier(max_docs=8, max_slots=64,
+                                 ops_per_dispatch=8, use_pallas=True,
+                                 pallas_interpret=True)
+    applier.set_replay_source(lambda t, d: [])
+    for m in channel_stream(server, "t", "pdoc", "default", "text"):
+        applier.ingest("t", "pdoc", m, m.contents)
+    applier.finalize()
+    assert applier.host_escalations == 0
+    assert applier.get_text("t", "pdoc") == s1.get_text()
