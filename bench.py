@@ -111,8 +111,8 @@ def bench_service() -> dict:
     # collections scanning the live scriptorium logs cost 2x the headline.
     gc.set_threshold(200000, 50, 50)
     trials = []
-    for t in range(3):
-        gc.collect()
+    for t in range(5):  # median of 5: bursty co-tenant CPU contention
+        gc.collect()      # can depress 2 trials in a row by ~2x
         gc.freeze()
         applier = TpuDocumentApplier(
             max_docs=1024, max_slots=256, ops_per_dispatch=32,
@@ -127,7 +127,7 @@ def bench_service() -> dict:
         assert stats.applier_ops == stats.ops_submitted
         trials.append(stats.summary())
     trials.sort(key=lambda s: s["ops_per_sec"])
-    headline = trials[1]
+    headline = trials[len(trials) // 2]
 
     # the north star names 10k-doc scale: prove the number holds at 8192
     # concurrent docs (393k ops through the full path, same assertions)
