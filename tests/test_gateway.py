@@ -133,3 +133,13 @@ def test_shared_text_example_demo_converges():
     assert "CONVERGED" in out.stdout
     assert "⟦verify deli ordering claim⟧" in out.stdout  # anchored comment
     assert "**Welcome**" in out.stdout  # bold annotation rendered
+
+
+def test_clicker_example_demo_converges():
+    """The SharedCounter example (BASELINE config 2): 4 clicker
+    processes hammer one counter and the total converges."""
+    out = subprocess.run(
+        [sys.executable, "-m", "examples.clicker"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED: 4 processes x 25 clicks = 100" in out.stdout
