@@ -203,6 +203,7 @@ class DeliLambda:
         # fast-lane accounting (bench asserts the hot path stayed hot)
         self.boxcars_fast = 0
         self.boxcars_fallback = 0
+        self.noops_consolidated = 0
         # clients whose idle-eviction leave is already riding the raw log
         # (re-emitting every check would bloat the log with duplicates
         # that replay forever after restarts)
@@ -529,9 +530,21 @@ class DeliLambda:
             )
             return
 
+        msn_before = self._min_ref_seq()
         client.client_sequence_number = op.client_sequence_number
         client.reference_sequence_number = op.reference_sequence_number
         client.last_update = now
+
+        if op.type == MessageType.NOOP and self._min_ref_seq() == msn_before:
+            # noop consolidation (ref: deli's noop timer): a heartbeat
+            # that does NOT move the document msn has nothing to tell
+            # anyone — the refSeq bookkeeping above is its whole effect,
+            # so it takes no sequence number. A floor-moving noop still
+            # sequences (ONE message makes the new msn visible, which is
+            # what lets quorum proposals commit). Deterministic on
+            # replay: a pure function of the record + prior state.
+            self.noops_consolidated += 1
+            return
 
         self.sequence_number += 1
         traces = list(op.traces)

@@ -308,3 +308,48 @@ def test_copier_archives_raw_traffic_including_rejected():
     assert ("boxcar", 1) in kinds
     assert ("boxcar", 9) in kinds  # the rejected submission is auditable
     assert copier.copied == len(rows)
+
+
+def test_noop_heartbeats_consolidate_out_of_the_stream():
+    """Client noops move the sender's refSeq (and thus the msn) without
+    occupying sequence numbers (ref: deli noop consolidation)."""
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.service import LocalServer
+
+    server = LocalServer()
+    w = server.connect("t", "doc")
+    idle = server.connect("t", "doc")
+    deli = server._get_orderer("t", "doc").deli
+    for i in range(1, 4):
+        w.submit([DocumentMessage(
+            client_sequence_number=i, reference_sequence_number=i,
+            type=MessageType.OPERATION, contents={"i": i})])
+    before = deli.sequence_number
+    pinned = deli._min_ref_seq()
+    assert pinned < before  # the idle client pins the msn below the head
+
+    # the FLOOR-MOVING noop sequences (one message makes the msn
+    # visible, so quorum proposals can commit)
+    idle.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=before,
+        type=MessageType.NOOP)])
+    assert deli.sequence_number == before + 1
+    assert deli._min_ref_seq() == before
+
+    # a REDUNDANT heartbeat (floor unchanged) consolidates away
+    w.submit([DocumentMessage(
+        client_sequence_number=4, reference_sequence_number=before,
+        type=MessageType.NOOP)])
+    assert deli.sequence_number == before + 1  # nothing sequenced
+    assert deli.noops_consolidated == 1
+
+    # the clientSeq the swallowed noop consumed does not read as a gap
+    w.submit([DocumentMessage(
+        client_sequence_number=5, reference_sequence_number=before,
+        type=MessageType.OPERATION, contents={"after": 1})])
+    assert deli.sequence_number == before + 2
+    log = server.get_deltas("t", "doc", 0, 10**9)
+    assert [m.type.value for m in log].count("noop") == 1
