@@ -337,18 +337,19 @@ def test_noop_heartbeats_consolidate_out_of_the_stream():
         client_sequence_number=1, reference_sequence_number=before,
         type=MessageType.NOOP)])
     assert deli.sequence_number == before + 1
-    assert deli._min_ref_seq() == before
+    assert deli._min_ref_seq() > pinned  # the floor moved
 
-    # a REDUNDANT heartbeat (floor unchanged) consolidates away
-    w.submit([DocumentMessage(
-        client_sequence_number=4, reference_sequence_number=before,
+    # a REDUNDANT heartbeat from the same client (floor unchanged)
+    # consolidates away
+    idle.submit([DocumentMessage(
+        client_sequence_number=2, reference_sequence_number=before,
         type=MessageType.NOOP)])
     assert deli.sequence_number == before + 1  # nothing sequenced
     assert deli.noops_consolidated == 1
 
     # the clientSeq the swallowed noop consumed does not read as a gap
-    w.submit([DocumentMessage(
-        client_sequence_number=5, reference_sequence_number=before,
+    idle.submit([DocumentMessage(
+        client_sequence_number=3, reference_sequence_number=before,
         type=MessageType.OPERATION, contents={"after": 1})])
     assert deli.sequence_number == before + 2
     log = server.get_deltas("t", "doc", 0, 10**9)
