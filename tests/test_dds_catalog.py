@@ -379,3 +379,41 @@ def test_queue_holder_leave_requeues_at_back(server, loader):
     assert [v for _, v in b.holding()] == ["w1"]
     c2.disconnect()  # holder leaves → its items requeue deterministically
     assert a.peek_values() == ["w2", "w1"]
+
+
+def test_matrix_1kx1k_eight_clients_concurrent(server, loader):
+    """BASELINE config 3: a 1000x1000 SharedMatrix with 8 clients making
+    concurrent cell edits (and concurrent shape edits) converges."""
+    import random
+
+    rng = random.Random(33)
+    c0 = loader.resolve("t", "grid")
+    m0 = c0.runtime.create_data_store("default").create_channel(
+        "grid", "shared-matrix")
+    m0.insert_rows(0, 1000)
+    m0.insert_cols(0, 1000)
+    clients = [c0] + [loader.resolve("t", "grid") for _ in range(7)]
+    mats = [c.runtime.get_data_store("default").get_channel("grid")
+            for c in clients]
+
+    server._auto_drain = False  # force real concurrency
+    server.drain()
+    edits = {}
+    for round_ in range(5):
+        for i, m in enumerate(mats):
+            for _ in range(5):
+                r, c = rng.randrange(m.row_count), rng.randrange(m.col_count)
+                m.set_cell(r, c, f"c{i}r{round_}")
+        if round_ == 2:
+            mats[3].insert_rows(500, 2)  # concurrent shape change
+        server.drain()
+    server._auto_drain = True
+    server.drain()
+
+    assert mats[0].row_count == 1002 and mats[0].col_count == 1000
+    ref = mats[0].to_lists()
+    for m in mats[1:]:
+        assert m.row_count == 1002 and m.col_count == 1000
+        assert m.to_lists() == ref
+    # some edits really landed
+    assert sum(1 for row in ref for v in row if v is not None) >= 100
