@@ -40,10 +40,11 @@ def open_counter(port: int, creator: bool):
         ds = container.runtime.create_data_store("default")
         counter = ds.create_channel("clicks", "shared-counter")
     else:
-        assert wait_until(
-            lambda: "default" in container.runtime.data_stores
-            and "clicks" in container.runtime
-            .get_data_store("default").channels)
+        if not wait_until(
+                lambda: "default" in container.runtime.data_stores
+                and "clicks" in container.runtime
+                .get_data_store("default").channels):
+            raise SystemExit("counter never replicated")
         counter = container.runtime.get_data_store("default") \
             .get_channel("clicks")
     return container, counter

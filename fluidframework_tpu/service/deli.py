@@ -530,7 +530,7 @@ class DeliLambda:
             )
             return
 
-        msn_before = self._min_ref_seq()
+        msn_before = msn  # nothing mutated since the nack check above
         client.client_sequence_number = op.client_sequence_number
         client.reference_sequence_number = op.reference_sequence_number
         client.last_update = now

@@ -398,7 +398,6 @@ def test_matrix_1kx1k_eight_clients_concurrent(server, loader):
 
     server._auto_drain = False  # force real concurrency
     server.drain()
-    edits = {}
     for round_ in range(5):
         for i, m in enumerate(mats):
             for _ in range(5):
