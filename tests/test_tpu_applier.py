@@ -197,3 +197,48 @@ def test_interval_only_batch_does_not_crash_dispatch():
     applier.finalize()
     assert applier.host_escalations == 0
     assert applier.get_text("t", "txt-doc") == "hi"
+
+
+def test_applier_checkpoint_warm_restart(tmp_path, server, loader):
+    """Device-farm checkpointing: save a fenced applier, load it in a
+    'new process', and continue ingesting live ops with no replay."""
+    from fluidframework_tpu.service.tpu_applier import (
+        load_applier_checkpoint,
+        save_applier_checkpoint,
+    )
+
+    docs = ["a", "b"]
+    strings = {}
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=4)
+    applier.set_replay_source(lambda t, d: [])
+    for d in docs:
+        c = loader.resolve("t", d)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, f"checkpointed {d} ")
+        s.annotate_range(0, 3, {"bold": True})
+        strings[d] = s
+        feed_applier(applier, server, "t", d)
+
+    path = str(tmp_path / "farm")
+    save_applier_checkpoint(applier, path)
+
+    revived = load_applier_checkpoint(path)
+    revived.set_replay_source(lambda t, d: [])
+    for d in docs:
+        assert revived.get_text("t", d) == strings[d].get_text()
+        assert revived.get_properties_at("t", d, 0).get("bold") is True
+
+    # the revived farm keeps ingesting the live stream where it left off
+    seen = {d: server.get_deltas("t", d, 0, 10**9)[-1].sequence_number
+            for d in docs}
+    for d in docs:
+        strings[d].insert_text(0, ">> ")
+        for m in channel_stream(server, "t", d, "default", "text"):
+            if m.sequence_number > seen[d]:
+                revived.ingest("t", d, m, m.contents)
+    revived.finalize()
+    assert revived.host_escalations == 0
+    for d in docs:
+        assert revived.get_text("t", d) == strings[d].get_text()
