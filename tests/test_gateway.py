@@ -143,3 +143,29 @@ def test_clicker_example_demo_converges():
         capture_output=True, text=True, timeout=120, cwd="/root/repo")
     assert out.returncode == 0, out.stdout + out.stderr
     assert "CONVERGED: 4 processes x 25 clicks = 100" in out.stdout
+
+
+def test_reconnect_rebase_through_gateway(topology):
+    """Offline edits rebase + resubmit across a RECONNECT whose new
+    session rides the gateway backbone (fresh sid, fresh upstream
+    registration)."""
+    _, p1, p2 = topology
+    l1 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+    l2 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p2))
+    c1 = l1.resolve("t", "rcdoc")
+    c2 = l2.resolve("t", "rcdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "base")
+    assert wait_for(lambda: "default" in c2.runtime.data_stores
+                    and "text" in c2.runtime.get_data_store("default").channels
+                    and c2.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "base")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+
+    c1.disconnect()
+    s1.insert_text(0, "X")   # offline edit on the gateway-1 client
+    s2.insert_text(4, "Y")   # concurrent edit through gateway 2
+    assert wait_for(lambda: s2.get_text() == "baseY")
+    c1.reconnect()
+    assert wait_for(lambda: s1.get_text() == s2.get_text() == "XbaseY")
