@@ -130,6 +130,11 @@ class LocalOrderer:
         ]
         for topic, handler, from_offset in self._subscriptions:
             self._log.subscribe(topic, handler, from_offset=from_offset)
+        # re-apply the persisted retention AFTER the deltas-topic replay
+        # rebuilt the full store (the replay itself is what un-truncated)
+        if log_cp is not None and log_cp.get("scriptorium_base", 0) > 0:
+            self.scriptorium.truncate_below(
+                tenant_id, document_id, log_cp["scriptorium_base"])
 
     # the front end calls this (alfred's connection.order()); accepts a
     # single RawMessage or a RawBoxcar (one log record either way)
@@ -145,7 +150,10 @@ class LocalOrderer:
     def checkpoint(self) -> None:
         """Persist deli + scribe state (ref: deli checkpointContext.ts,
         scribe checkpointManager.ts → Mongo) — to the db and, so a durable
-        log can recover it after full process death, to the log too."""
+        log can recover it after full process death, to the log too. The
+        scriptorium retention base rides along: without it a restart
+        would rebuild the full delta store from the durable deltas topic
+        and silently undo the truncation."""
         deli_state = self.deli.checkpoint().to_dict()
         scribe_state = self.scribe.checkpoint_state()
         key = f"{self.tenant_id}/{self.document_id}"
@@ -153,7 +161,9 @@ class LocalOrderer:
         self._db.upsert(SCRIBE_CHECKPOINT_COLLECTION, key, {"state": scribe_state})
         self._log.append(
             _checkpoint_topic(self.tenant_id, self.document_id),
-            {"deli": deli_state, "scribe": scribe_state},
+            {"deli": deli_state, "scribe": scribe_state,
+             "scriptorium_base": self.scriptorium.retained_base(
+                 self.tenant_id, self.document_id)},
         )
 
     def _on_sequenced(self, msg: SequencedDocumentMessage) -> None:
