@@ -81,6 +81,13 @@ class LocalStorage(DocumentStorage):
     """
 
     def __init__(self, server: LocalServer, tenant_id: str, document_id: str):
+        from ..service.local_orderer import restore_version_records
+
+        # durable-log deployments: acked version records may only exist
+        # on the versions topic after a process restart (boot reads
+        # storage BEFORE any orderer exists to restore them)
+        restore_version_records(server.log, server.db, tenant_id,
+                                document_id)
         self._db = server.db
         self._blobs = server.blob_store
         self._stats = server.storage_stats

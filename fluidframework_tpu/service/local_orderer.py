@@ -23,6 +23,34 @@ from .scriptorium import ScriptoriumLambda
 CHECKPOINT_COLLECTION = "deli-checkpoints"
 
 
+def _versions_topic(tenant_id: str, document_id: str) -> str:
+    return f"versions/{tenant_id}/{document_id}"
+
+
+def restore_version_records(log, db, tenant_id: str,
+                            document_id: str) -> None:
+    """Rebuild acked summary-version records from the durable versions
+    topic. After full process death the db is gone, and without these the
+    summary chain (and, with retention, the doc) is unreachable — blob
+    durability comes from the native chunk store; RECORD durability comes
+    from here. Called by both the orderer and the storage facade (boot
+    reads storage before any orderer exists)."""
+    from .core import summary_versions_collection
+
+    topic = _versions_topic(tenant_id, document_id)
+    try:
+        n = log.length(topic)
+    except Exception:
+        return
+    if n <= 0:
+        return
+    col = summary_versions_collection(tenant_id, document_id)
+    for i in range(n):
+        rec = log.read(topic, i)
+        if db.find_one(col, rec["handle"]) is None:
+            db.upsert(col, rec["handle"], dict(rec["version"]))
+
+
 def _checkpoint_topic(tenant_id: str, document_id: str) -> str:
     # per-doc topic: the newest checkpoint is simply the last record, and
     # old records compact trivially
@@ -106,6 +134,10 @@ class LocalOrderer:
                 # for in-flight backfills (config.log_retention_ops)
                 self.scriptorium.truncate_below(
                     tenant_id, document_id, capture_seq - retention)
+        def persist_version(handle: str, version: dict) -> None:
+            log.append(_versions_topic(tenant_id, document_id),
+                       {"handle": handle, "version": dict(version)})
+
         self.scribe = ScribeLambda(
             tenant_id,
             document_id,
@@ -113,7 +145,9 @@ class LocalOrderer:
             send_to_deli=self.order,
             checkpoint=scribe_state,
             on_summary_committed=on_committed,
+            persist_version=persist_version,
         )
+        restore_version_records(log, db, tenant_id, document_id)
 
         # deli replays the raw topic from 0 and self-skips via its
         # checkpointed log_offset (crash between append and ticket must

@@ -40,6 +40,7 @@ class ScribeLambda:
         send_to_deli: Callable[[RawMessage], None],
         checkpoint: Optional[dict] = None,
         on_summary_committed: Optional[Callable[[int], None]] = None,
+        persist_version: Optional[Callable[[str, dict], None]] = None,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
@@ -48,6 +49,10 @@ class ScribeLambda:
         # fires with the committed summary's capture seq — the hook log
         # retention hangs off (ops the summary covers may truncate)
         self._on_committed = on_summary_committed
+        # persists the acked version RECORD outside the db (the durable
+        # log), so summaries survive full process death — without it a
+        # truncated log + dead db leaves the doc unbootable
+        self._persist_version = persist_version
         self._versions_col = summary_versions_collection(tenant_id, document_id)
         if checkpoint:
             self.protocol = ProtocolOpHandler.load(checkpoint["protocol"])
@@ -116,8 +121,11 @@ class ScribeLambda:
             return
 
         # commit: mark the version acked (the git ref update analog)
-        self._db.upsert(self._versions_col, handle, dict(version, acked=True))
+        acked_version = dict(version, acked=True)
+        self._db.upsert(self._versions_col, handle, acked_version)
         self.last_summary_head = handle
+        if self._persist_version is not None:
+            self._persist_version(handle, acked_version)
         if self._on_committed is not None:
             self._on_committed(head)
         self._send_to_deli(

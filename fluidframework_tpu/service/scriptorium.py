@@ -82,13 +82,19 @@ class ScriptoriumLambda:
     def truncate_below(self, tenant_id: str, document_id: str,
                        seq: int) -> int:
         """Drop retained ops with sequence_number ≤ seq; returns how many
-        were dropped. Callers pass (acked summary seq − retention)."""
+        were dropped. Callers pass (acked summary seq − retention).
+
+        The base RAISES even past the held range (or on an empty store):
+        a checkpoint restore declares the prefix gone BEFORE the durable
+        deltas-topic replay re-delivers it, and the append path then
+        drops everything at or below the declared base."""
         doc = self._doc(self.collection(tenant_id, document_id))
         base = doc.get("base", 0)
-        drop = min(max(seq - base, 0), len(doc["messages"]))
-        if drop > 0:
-            del doc["messages"][:drop]
-            doc["base"] = base + drop
+        if seq <= base:
+            return 0
+        drop = min(seq - base, len(doc["messages"]))
+        del doc["messages"][:drop]
+        doc["base"] = seq
         return drop
 
     def retained_base(self, tenant_id: str, document_id: str) -> int:

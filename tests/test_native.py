@@ -228,8 +228,12 @@ def test_durable_restart_with_truncated_retention(tmp_path):
     from fluidframework_tpu.service.durable_log import DurableLog
 
     path = str(tmp_path / "svc-log")
+    blobs = str(tmp_path / "blobs")
     cfg = Config().with_overrides(log_retention_ops=3)
-    server = LocalServer(log=DurableLog(path), config=cfg)
+    # durable EVERYTHING: log (native oplog), blobs (native chunk
+    # store), version records (versions topic in the log)
+    server = LocalServer(log=DurableLog(path), config=cfg,
+                         storage_dir=blobs)
     loader = Loader(LocalDocumentServiceFactory(server))
     c1 = loader.resolve("t", "doc")
     sm = SummaryManager(c1, max_ops=10**9)
@@ -246,7 +250,8 @@ def test_durable_restart_with_truncated_retention(tmp_path):
     server.log.close()
     del server
 
-    server2 = LocalServer(log=DurableLog(path), config=cfg)
+    server2 = LocalServer(log=DurableLog(path), config=cfg,
+                          storage_dir=blobs)
     loader2 = Loader(LocalDocumentServiceFactory(server2))
     c2 = loader2.resolve("t", "doc")  # boots from summary + retained tail
     s2 = c2.runtime.get_data_store("default").get_channel("text")
