@@ -85,9 +85,17 @@ class LocalStorage(DocumentStorage):
 
         # durable-log deployments: acked version records may only exist
         # on the versions topic after a process restart (boot reads
-        # storage BEFORE any orderer exists to restore them)
-        restore_version_records(server.log, server.db, tenant_id,
-                                document_id)
+        # storage BEFORE any orderer exists to restore them). Once per
+        # (tenant, doc) per process: LocalStorage is constructed per
+        # storage RPC, and an unmemoized scan would tax every request
+        # with O(#summaries) log reads.
+        restored = getattr(server, "_versions_restored", None)
+        if restored is None:
+            restored = server._versions_restored = set()
+        if (tenant_id, document_id) not in restored:
+            restore_version_records(server.log, server.db, tenant_id,
+                                    document_id)
+            restored.add((tenant_id, document_id))
         self._db = server.db
         self._blobs = server.blob_store
         self._stats = server.storage_stats

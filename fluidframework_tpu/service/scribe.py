@@ -121,10 +121,14 @@ class ScribeLambda:
             return
 
         # commit: mark the version acked (the git ref update analog)
+        already_acked = bool(version.get("acked"))
         acked_version = dict(version, acked=True)
         self._db.upsert(self._versions_col, handle, acked_version)
         self.last_summary_head = handle
-        if self._persist_version is not None:
+        if self._persist_version is not None and not already_acked:
+            # a post-restart replay re-commits an already-restored
+            # version; appending again would grow the durable topic
+            # with duplicates on every restart
             self._persist_version(handle, acked_version)
         if self._on_committed is not None:
             self._on_committed(head)
