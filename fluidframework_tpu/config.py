@@ -66,10 +66,24 @@ class Config:
         overrides: dict[str, Any] = {}
         for f in fields(cls):
             raw = os.environ.get(ENV_PREFIX + f.name.upper())
-            if raw is None:
+            if raw is None or raw.strip() == "":
+                # set-but-empty (export FLUID_TPU_X=) means "unset" in
+                # shell convention: keep the layered default
                 continue
             typ = type(getattr(base, f.name))
-            overrides[f.name] = typ(raw)
+            if typ is bool:
+                # bool("0") is True — parse the usual spellings instead
+                low = raw.strip().lower()
+                if low in ("1", "true", "yes", "on"):
+                    overrides[f.name] = True
+                elif low in ("0", "false", "no", "off"):
+                    overrides[f.name] = False
+                else:
+                    raise ValueError(
+                        f"{ENV_PREFIX}{f.name.upper()}={raw!r}: expected a "
+                        "boolean (1/0/true/false/yes/no/on/off)")
+            else:
+                overrides[f.name] = typ(raw)
         return base.with_overrides(**overrides)
 
 

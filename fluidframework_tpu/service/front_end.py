@@ -266,9 +266,14 @@ class _ClientSession:
 
             tenant, doc = frame["tenant"], frame["doc"]
             # validate BEFORE creating the topic subscription: a refused
-            # connect must not leak a subscription
+            # connect must not leak a subscription. Require only read
+            # scope here — server.connect() below assigns read/write mode
+            # from the token exactly as the direct door does, so a
+            # read-only token gets a read-mode connection, not a refusal.
             if server.tenants is not None:
-                server.tenants.validate(frame.get("token"), tenant, doc)
+                from .tenants import SCOPE_READ
+                server.tenants.validate(frame.get("token"), tenant, doc,
+                                        required_scope=SCOPE_READ)
             topic = BroadcasterLambda.topic(tenant, doc)
             # the gateway's topic subscription must exist BEFORE the join
             # is ordered: connect() sequences + broadcasts the join

@@ -47,8 +47,15 @@ class _GatewaySession:
         self.writer = writer
         self.sid: Optional[int] = None
         self.topic: Optional[str] = None
+        # While a connect awaits the core's auth verdict, broadcasts are
+        # held here instead of the socket; flushed on success, dropped on
+        # refusal. None = no gate (normal delivery).
+        self._gate_buffer: Optional[list[bytes]] = None
 
     def push_raw(self, raw: bytes) -> None:
+        if self._gate_buffer is not None:
+            self._gate_buffer.append(raw)
+            return
         try:
             if not self.writer.is_closing():
                 self.writer.write(raw)
@@ -62,19 +69,40 @@ class _GatewaySession:
         t = frame.get("t")
         gw = self.gw
         if t == "connect":
+            # A re-connect on a live session must first release the old
+            # registration, else the prior sid's core-side connection and
+            # topic refcount leak until the socket closes.
+            if self.sid is not None:
+                self.detach()
             self.sid = next(gw.sid_counter)
             self.topic = f"{frame['tenant']}/{frame['doc']}"
+            # Register NOW (the core broadcasts this client's own join
+            # synchronously with the fconnect — miss it and the client
+            # never activates) but GATE delivery behind the core's auth
+            # verdict: buffered frames flush only on success, and a
+            # refusal unregisters + drops the buffer, so a rejected
+            # (tokenless) client never receives a byte of the doc's live
+            # stream even while authorized clients keep the topic open.
+            self._gate_buffer = []
             gw.sessions[self.sid] = self
             gw.topic_sessions.setdefault(self.topic, set()).add(self)
-            reply = await gw.upstream_request({
-                "t": "fconnect", "sid": self.sid,
-                "tenant": frame["tenant"], "doc": frame["doc"],
-                "details": frame.get("details"),
-                "token": frame.get("token")})
+            try:
+                reply = await gw.upstream_request({
+                    "t": "fconnect", "sid": self.sid,
+                    "tenant": frame["tenant"], "doc": frame["doc"],
+                    "details": frame.get("details"),
+                    "token": frame.get("token")})
+            except BaseException:
+                self._gate_buffer = None
+                self.detach()
+                raise
+            self._gate_buffer, buffered = None, self._gate_buffer
             self.push({"t": "connected", "rid": frame.get("rid"),
                        "clientId": reply["clientId"], "seq": reply["seq"],
                        "mode": reply.get("mode", "write"),
                        "maxMessageSize": reply.get("maxMessageSize")})
+            for raw in buffered:
+                self.push_raw(raw)
         elif t == "submit":
             # ops pass through verbatim — no payload re-encode
             gw.upstream_send({"t": "fsubmit", "sid": self.sid,

@@ -120,18 +120,7 @@ class ScribeLambda:
             self._nack(msg, f"summary head {head!r} is ahead of the stream")
             return
 
-        # commit: mark the version acked (the git ref update analog)
-        already_acked = bool(version.get("acked"))
-        acked_version = dict(version, acked=True)
-        self._db.upsert(self._versions_col, handle, acked_version)
-        self.last_summary_head = handle
-        if self._persist_version is not None and not already_acked:
-            # a post-restart replay re-commits an already-restored
-            # version; appending again would grow the durable topic
-            # with duplicates on every restart
-            self._persist_version(handle, acked_version)
-        if self._on_committed is not None:
-            self._on_committed(head)
+        self.commit_version(handle, head, version=version)
         self._send_to_deli(
             RawMessage(
                 tenant_id=self.tenant_id,
@@ -148,6 +137,32 @@ class ScribeLambda:
                 ),
             )
         )
+
+    def commit_version(self, handle: str, head: int,
+                       version: Optional[dict] = None) -> None:
+        """Commit a version as the acked head — the single ref-update path.
+
+        Used by both client summaries (_handle_summarize) and service
+        summaries (service_summarizer.py): flips acked, appends to the
+        durable versions topic, updates the head, and fires the retention
+        callback. Writing around this (e.g. upserting acked=True directly
+        in the db) makes the summary vanish on full process death and
+        never advances log retention."""
+        if version is None:
+            version = self._db.find_one(self._versions_col, handle)
+            if version is None:
+                raise KeyError(f"unknown summary handle {handle!r}")
+        already_acked = bool(version.get("acked"))
+        acked_version = dict(version, acked=True)
+        self._db.upsert(self._versions_col, handle, acked_version)
+        self.last_summary_head = handle
+        if self._persist_version is not None and not already_acked:
+            # a post-restart replay re-commits an already-restored
+            # version; appending again would grow the durable topic
+            # with duplicates on every restart
+            self._persist_version(handle, acked_version)
+        if self._on_committed is not None:
+            self._on_committed(head)
 
     def _nack(self, msg: SequencedDocumentMessage, reason: str) -> None:
         # boot visibility needs no marking here: only versions scribe acks

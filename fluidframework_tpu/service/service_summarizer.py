@@ -19,7 +19,6 @@ from __future__ import annotations
 from typing import Optional
 
 from ..driver.local import LocalStorage
-from .core import summary_versions_collection
 
 DS_ID = "default"
 TEXT_CHANNEL = "text"
@@ -43,13 +42,14 @@ class ServiceSummarizer:
         directly, the writeServiceSummary contract)."""
         orderer = self.server._get_orderer(tenant_id, document_id)
         scribe = orderer.scribe
+        pkg = self._check_summarizable(tenant_id, document_id, orderer)
         replica = self.applier.get_tree(tenant_id, document_id)
         summary = {
             "protocol": scribe.protocol.snapshot(),
             "runtime": {
                 "dataStores": {
                     self.ds_id: {
-                        "pkg": "default",
+                        "pkg": pkg,
                         "snapshot": {
                             "channels": {
                                 self.channel_id: {
@@ -69,13 +69,95 @@ class ServiceSummarizer:
         storage = LocalStorage(self.server, tenant_id, document_id)
         version_id = storage.upload_summary(
             summary, parent=scribe.last_summary_head)
-        # the service is its own validator: flip the ref directly
-        col = summary_versions_collection(tenant_id, document_id)
-        version = self.server.db.find_one(col, version_id)
-        self.server.db.upsert(col, version_id, dict(version, acked=True))
-        scribe.last_summary_head = version_id
+        # the service is its own validator, but must still commit through
+        # the scribe's ref-update path so the version reaches the durable
+        # versions topic (survives process death) and retention advances
+        scribe.commit_version(version_id, scribe.protocol.sequence_number)
         self.summaries_written += 1
         return version_id
+
+    def _check_summarizable(self, tenant_id: str, document_id: str,
+                            orderer) -> str:
+        """The refusal gate (module docstring contract). Committing a
+        service summary advances retention past scribe's seq, so anything
+        the summary does not contain must provably not exist:
+
+        - the applier must not LAG the stream (its state is the content);
+        - the doc must hold ONLY the device-modeled data store/channel —
+          foreign chanops truncated from the log while absent from the
+          summary would be lost permanently;
+        - when retention already truncated a prefix, the applier must
+          cover it (applied >= base) and the PRIOR acked summary must not
+          carry foreign content the stream no longer shows.
+
+        Returns the data store's pkg (from its attach op, or the prior
+        summary) so the new summary boots the same code."""
+        from ..protocol.messages import MessageType
+
+        base = orderer.scriptorium.retained_base(tenant_id, document_id)
+        applied = self.applier.applied_seq(tenant_id, document_id)
+        if base > 0 and applied < base:
+            raise RuntimeError(
+                f"applier state for {tenant_id}/{document_id} predates the "
+                f"retention base {base} (applied seq {applied}): the "
+                "truncated ops are not provably in the device state")
+        pkg = "default"
+        last_channel_seq = 0
+        for m in orderer.scriptorium.get_deltas(
+                tenant_id, document_id, base, 10**9):
+            if m.type != MessageType.OPERATION:
+                continue
+            env = m.contents
+            if not isinstance(env, dict):
+                continue
+            kind = env.get("kind")
+            if kind == "attach":
+                if env.get("id") != self.ds_id:
+                    raise RuntimeError(
+                        f"doc {tenant_id}/{document_id} has a data store "
+                        f"{env.get('id')!r} the device does not model — "
+                        "keep client summaries for this doc")
+                pkg = env.get("pkg", pkg)
+                foreign = set((env.get("snapshot") or {})
+                              .get("channels") or {}) - {self.channel_id}
+                if foreign:
+                    raise RuntimeError(
+                        f"doc {tenant_id}/{document_id} attached with "
+                        f"non-modeled channels {sorted(foreign)}")
+            elif kind == "chanop":
+                inner = env.get("contents") or {}
+                if env.get("address") != self.ds_id or \
+                        inner.get("address") != self.channel_id:
+                    raise RuntimeError(
+                        f"doc {tenant_id}/{document_id} has ops for "
+                        f"{env.get('address')}/{inner.get('address')} the "
+                        "device does not model — keep client summaries")
+                if "attach" not in inner:
+                    last_channel_seq = m.sequence_number
+        if applied < last_channel_seq:
+            raise RuntimeError(
+                f"applier lags the stream for {tenant_id}/{document_id}: "
+                f"applied seq {applied} < last channel op "
+                f"{last_channel_seq}; feed the applier before summarizing")
+        if base > 0:
+            # content below the base is only reachable through the prior
+            # acked summary — it must not hold anything we would drop
+            prior = LocalStorage(self.server, tenant_id,
+                                 document_id).get_snapshot_tree()
+            stores = ((prior or {}).get("runtime") or {}) \
+                .get("dataStores") or {}
+            foreign_ds = set(stores) - {self.ds_id}
+            ours = (stores.get(self.ds_id) or {})
+            foreign_ch = set((ours.get("snapshot") or {})
+                             .get("channels") or {}) - {self.channel_id}
+            if foreign_ds or foreign_ch:
+                raise RuntimeError(
+                    f"prior summary of {tenant_id}/{document_id} holds "
+                    f"non-modeled content (stores {sorted(foreign_ds)}, "
+                    f"channels {sorted(foreign_ch)}) — keep client "
+                    "summaries for this doc")
+            pkg = ours.get("pkg", pkg)
+        return pkg
 
     def summarize_all(self, tenant_id: str, documents: list[str],
                       min_seq: Optional[int] = None) -> int:

@@ -138,7 +138,7 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
 
 
 def channel_stream(server, tenant_id: str, document_id: str,
-                   ds_id: str, channel_id: str):
+                   ds_id: str, channel_id: str, from_seq: int = 0):
     """Extract one channel's merge-tree messages from the document's
     sequenced op log (scriptorium) — the applier's replay source and the
     scribe-replay entry point (BASELINE config 5).
@@ -152,7 +152,7 @@ def channel_stream(server, tenant_id: str, document_id: str,
     from .scriptorium import ScriptoriumLambda
 
     for m in ScriptoriumLambda(server.db).get_deltas(
-            tenant_id, document_id, 0, 10**9):
+            tenant_id, document_id, from_seq, 10**9):
         if m.type != MessageType.OPERATION:
             continue
         env = m.contents
@@ -258,6 +258,10 @@ class TpuDocumentApplier:
         self.dispatches = 0
         self.ops_applied = 0
         self.host_escalations = 0
+        # highest ingested sequence number per slot — consumers that write
+        # summaries from device state (service_summarizer.py) compare this
+        # against the stream to refuse summarizing a lagging doc
+        self._applied_seq: dict[int, int] = {}
         # async mode: a worker thread owns wave building + host→device
         # transfer + dispatch, so tunnel transfer latency never blocks the
         # ordering pipeline — the applier becomes a real pipeline stage
@@ -331,6 +335,11 @@ class TpuDocumentApplier:
         the deli-tpu marshal's per-boxcar entry point. Staging is plain
         tuple appends; device encoding happens once per flush."""
         slot = self.slot_of(tenant_id, document_id)
+        if pairs:
+            # sequenced stream ⇒ pairs arrive in seq order; the last is max
+            self._applied_seq[slot] = max(
+                self._applied_seq.get(slot, 0),
+                pairs[-1][0].sequence_number)
         if slot in self._host_docs:
             for msg, wire_op in pairs:
                 self._apply_host(slot, msg, wire_op)
@@ -719,6 +728,13 @@ class TpuDocumentApplier:
         replica._ids.update(self._client_ids.get(slot, {}))
         return replica
 
+    def applied_seq(self, tenant_id: str, document_id: str) -> int:
+        """Highest sequence number ingested for the doc (0 if none).
+        Summary writers compare this against the stream's last channel op
+        to refuse writing a summary from lagging device state."""
+        return self._applied_seq.get(
+            self.slot_of(tenant_id, document_id), 0)
+
     def get_properties_at(self, tenant_id: str, document_id: str,
                           pos: int) -> dict:
         """Properties of the visible character at ``pos`` (final
@@ -765,6 +781,8 @@ class TpuDocumentApplier:
         for m in self._replay_log(tenant_id, document_id):
             if m.type == MessageType.OPERATION:
                 replica.apply_msg(m, local=False)
+        self._applied_seq[slot] = max(self._applied_seq.get(slot, 0),
+                                      replica.tree.current_seq)
         if msg is not None:
             self._apply_host(slot, msg, wire_op)
 
@@ -816,6 +834,8 @@ def save_applier_checkpoint(applier: "TpuDocumentApplier",
                       for k, replica in applier._host_docs.items()},
         "host_doc_names": {str(k): applier._doc_keys[k]
                            for k in applier._host_docs},
+        "applied_seq": {str(k): v
+                        for k, v in applier._applied_seq.items()},
     }
     np.savez_compressed(path + ".npz", **arrays)
     with open(path + ".json", "w") as f:
@@ -851,4 +871,6 @@ def load_applier_checkpoint(path: str, **applier_kwargs
         tenant_id, document_id = meta["host_doc_names"][k]
         applier._host_docs[int(k)] = MergeTreeClient.load(
             f"tpu-applier/{tenant_id}/{document_id}", snap)
+    applier._applied_seq = {int(k): v for k, v in
+                            meta.get("applied_seq", {}).items()}
     return applier

@@ -5,6 +5,8 @@ Ref: services/src/socketIoRedisPublisher.ts (cross-instance broadcast),
 lambdas-driver partition rebalance.
 """
 
+import json
+import socket
 import subprocess
 import sys
 import time
@@ -13,6 +15,7 @@ import pytest
 
 from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
 from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service.tenants import SCOPE_READ, sign_token
 
 
 def _spawn(args):
@@ -143,6 +146,131 @@ def test_clicker_example_demo_converges():
         capture_output=True, text=True, timeout=120, cwd="/root/repo")
     assert out.returncode == 0, out.stdout + out.stderr
     assert "CONVERGED: 4 processes x 25 clicks = 100" in out.stdout
+
+
+# --------------------------------------------------------- secured gateway
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    sock.sendall(len(body).to_bytes(4, "big") + body)
+
+
+def _recv_frame(sock: socket.socket, timeout: float):
+    """One length-prefixed frame, or None on timeout."""
+    sock.settimeout(timeout)
+    try:
+        buf = b""
+        while len(buf) < 4:
+            chunk = sock.recv(4 - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        n = int.from_bytes(buf, "big")
+        body = b""
+        while len(body) < n:
+            chunk = sock.recv(n - len(body))
+            if not chunk:
+                return None
+            body += chunk
+        return json.loads(body.decode())
+    except socket.timeout:
+        return None
+
+
+@pytest.fixture(scope="module")
+def secured_topology():
+    """Core with tenancy enforced + one gateway in front of it."""
+    core, core_port = _spawn(
+        ["fluidframework_tpu.service.front_end", "--port", "0",
+         "--tenant", "acme:s3cret"])
+    gw, p = _spawn(["fluidframework_tpu.service.gateway",
+                    "--core-port", str(core_port)])
+    try:
+        yield p
+    finally:
+        for proc in (gw, core):
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+def _signed_factory(port, **token_kwargs):
+    return NetworkDocumentServiceFactory(
+        "127.0.0.1", port,
+        token_provider=lambda t, d: sign_token(t, d, "s3cret",
+                                               **token_kwargs))
+
+
+def test_rejected_gateway_connect_receives_no_broadcasts(secured_topology):
+    """Auth regression: a tokenless client whose connect the core REFUSES
+    must not be left subscribed to the doc's live op stream on the
+    gateway (the round-3 advisor finding)."""
+    p = secured_topology
+    eaves = socket.create_connection(("127.0.0.1", p))
+    try:
+        _send_frame(eaves, {"t": "connect", "tenant": "acme",
+                            "doc": "secdoc", "rid": 1})
+        reply = _recv_frame(eaves, 10.0)
+        assert reply is not None and reply["t"] == "error"
+
+        # an authorized client on the SAME gateway keeps the topic live
+        loader = Loader(_signed_factory(p))
+        c1 = loader.resolve("acme", "secdoc")
+        s1 = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s1.insert_text(0, "classified")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+
+        # the refused socket must see NOTHING of that traffic
+        leaked = _recv_frame(eaves, 1.0)
+        assert leaked is None, f"tokenless client received {leaked!r}"
+    finally:
+        eaves.close()
+
+
+def test_read_scope_token_connects_read_mode_via_gateway(secured_topology):
+    """A doc:read token must get a read-mode connection through the
+    gateway, exactly as at the direct door — not an outright refusal."""
+    p = secured_topology
+    svc = _signed_factory(p, scopes=(SCOPE_READ,)) \
+        .create_document_service("acme", "readdoc")
+    conn = svc.connect_to_delta_stream()
+    assert conn.mode == "read"
+    conn.close()
+
+
+def test_gateway_reconnect_frame_releases_previous_registration(
+        secured_topology):
+    """A second connect frame on a live gateway socket must detach the
+    first registration (old client leaves the quorum) instead of
+    orphaning its core-side connection."""
+    p = secured_topology
+    loader = Loader(_signed_factory(p))
+    observer = loader.resolve("acme", "redoc")
+    token = sign_token("acme", "redoc", "s3cret")
+
+    raw = socket.create_connection(("127.0.0.1", p))
+    try:
+        _send_frame(raw, {"t": "connect", "tenant": "acme", "doc": "redoc",
+                          "token": token, "rid": 1,
+                          "details": {"mode": "write"}})
+        first = _recv_frame(raw, 10.0)
+        assert first["t"] == "connected"
+        a = first["clientId"]
+        assert wait_for(lambda: a in observer.audience)
+
+        _send_frame(raw, {"t": "connect", "tenant": "acme", "doc": "redoc",
+                          "token": token, "rid": 2,
+                          "details": {"mode": "write"}})
+        second = None
+        while second is None or second["t"] != "connected":
+            second = _recv_frame(raw, 10.0)
+            assert second is not None
+        b = second["clientId"]
+        assert b != a
+        assert wait_for(lambda: a not in observer.audience
+                        and b in observer.audience)
+    finally:
+        raw.close()
 
 
 def test_reconnect_rebase_through_gateway(topology):

@@ -81,3 +81,96 @@ def test_batch_service_summaries(server, loader):
         assert c._base_snapshot is not None
         assert (c.runtime.get_data_store("default").get_channel("text")
                 .get_text() == strings[d].get_text())
+
+
+def test_service_summary_survives_full_process_death(tmp_path):
+    """ADVICE r3: a service-written summary must commit through the
+    scribe's ref-update path so it reaches the durable versions topic —
+    after full process death a fresh client still boots from it."""
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    path = str(tmp_path / "svc-log")
+    blobs = str(tmp_path / "blobs")  # blob durability = native chunkstore
+    server = LocalServer(log=DurableLog(path), storage_dir=blobs)
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "durable service summary")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "doc")
+    version = ServiceSummarizer(server, applier).summarize_doc("t", "doc")
+    server.checkpoint_all()
+    server.log.sync()
+    server.log.close()
+    del server
+
+    server2 = LocalServer(log=DurableLog(path), storage_dir=blobs)
+    # the acked version was restored from the durable topic, not lost
+    scribe2 = server2._get_orderer("t", "doc").scribe
+    assert scribe2.last_summary_head == version
+    c2 = Loader(LocalDocumentServiceFactory(server2)).resolve("t", "doc")
+    assert c2._base_snapshot is not None
+    assert (c2.runtime.get_data_store("default").get_channel("text")
+            .get_text() == "durable service summary")
+
+
+def test_summarize_refuses_lagging_applier(server, loader):
+    """Code-review r4: a service summary written from device state that
+    LAGS the stream would claim coverage it doesn't have and let
+    retention truncate the missing ops — the summarizer must refuse."""
+    c1 = loader.resolve("t", "lagdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "abc")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "lagdoc")
+    svc = ServiceSummarizer(server, applier)
+
+    # more ops AFTER the feed: the applier now lags the stream
+    s1.insert_text(3, "def")
+    with pytest.raises(RuntimeError, match="lags"):
+        svc.summarize_doc("t", "lagdoc")
+
+    # catching up makes it summarizable again
+    feed(applier, server, "t", "lagdoc")
+    assert svc.summarize_doc("t", "lagdoc") is not None
+
+
+def test_summarize_refuses_non_modeled_content(server, loader):
+    """The module-docstring contract: a doc holding channels the device
+    does not model must keep client summaries — a service summary would
+    drop them while retention truncates their ops."""
+    c1 = loader.resolve("t", "mixdoc")
+    ds = c1.runtime.create_data_store("default")
+    s = ds.create_channel("text", "shared-string")
+    s.insert_text(0, "text part")
+    kv = ds.create_channel("kv", "shared-map")
+    kv.set("k", "v")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "mixdoc")
+    svc = ServiceSummarizer(server, applier)
+    with pytest.raises(RuntimeError, match="not model"):
+        svc.summarize_doc("t", "mixdoc")
+
+    # a second data store is refused just the same
+    c2 = loader.resolve("t", "dsdoc")
+    c2.runtime.create_data_store("default").create_channel(
+        "text", "shared-string").insert_text(0, "x")
+    c2.runtime.create_data_store("other").create_channel(
+        "text", "shared-string")
+    applier2 = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                  ops_per_dispatch=8)
+    applier2.set_replay_source(lambda t, d: [])
+    feed(applier2, server, "t", "dsdoc")
+    with pytest.raises(RuntimeError, match="data store"):
+        ServiceSummarizer(server, applier2).summarize_doc("t", "dsdoc")
