@@ -34,6 +34,7 @@ class ServiceSummarizer:
         self.ds_id = ds_id
         self.channel_id = channel_id
         self.summaries_written = 0
+        self.refusals: list[tuple[str, str, str]] = []
 
     def summarize_doc(self, tenant_id: str, document_id: str) -> str:
         """Decode the doc from the device, compose a bootable container
@@ -73,6 +74,9 @@ class ServiceSummarizer:
         # the scribe's ref-update path so the version reaches the durable
         # versions topic (survives process death) and retention advances
         scribe.commit_version(version_id, scribe.protocol.sequence_number)
+        # the gate pass proved full coverage — anchor the slot so the doc
+        # stays summarizable after this commit's own retention truncation
+        self.applier.mark_anchored(tenant_id, document_id)
         self.summaries_written += 1
         return version_id
 
@@ -86,9 +90,14 @@ class ServiceSummarizer:
         - the doc must hold ONLY the device-modeled data store/channel —
           foreign chanops truncated from the log while absent from the
           summary would be lost permanently;
-        - when retention already truncated a prefix, the applier must
-          cover it (applied >= base) and the PRIOR acked summary must not
-          carry foreign content the stream no longer shows.
+        - the applier's coverage must be PROVEN complete: either anchored
+          (checkpoint restore / authoritative replay / an earlier gate
+          pass) or, with the log untruncated, ingested from the doc's
+          first channel op. A max-seq check alone would admit an applier
+          fed only the post-truncation tail and drop the prefix.
+        - when retention already truncated a prefix, the PRIOR acked
+          summary must not carry foreign content the stream no longer
+          shows.
 
         Returns the data store's pkg (from its attach op, or the prior
         summary) so the new summary boots the same code."""
@@ -96,13 +105,29 @@ class ServiceSummarizer:
 
         base = orderer.scriptorium.retained_base(tenant_id, document_id)
         applied = self.applier.applied_seq(tenant_id, document_id)
-        if base > 0 and applied < base:
+        anchored = self.applier.is_anchored(tenant_id, document_id)
+        if base > 0 and not anchored:
             raise RuntimeError(
-                f"applier state for {tenant_id}/{document_id} predates the "
-                f"retention base {base} (applied seq {applied}): the "
-                "truncated ops are not provably in the device state")
+                f"applier coverage for {tenant_id}/{document_id} is not "
+                f"anchored and the log is truncated below seq {base}: "
+                "the prefix is not provably in the device state")
         pkg = "default"
+        first_channel_seq = 0
         last_channel_seq = 0
+        # restart-window check: a checkpoint-restored anchor is only valid
+        # if NO channel op was sequenced between the checkpoint and the
+        # point the feed resumed — such ops are in the log but not in the
+        # restored device state
+        gap = self.applier.restore_gap(tenant_id, document_id)
+        gap_lo, gap_hi = (gap if gap is not None else (None, None))
+        if gap_lo is not None and base > gap_lo:
+            # the log was truncated beyond the checkpoint point (a client
+            # summary committed during/after the downtime): the restart
+            # window is no longer inspectable, so coverage is unprovable
+            raise RuntimeError(
+                f"doc {tenant_id}/{document_id}: retention base {base} "
+                f"passed the applier's checkpoint seq {gap_lo} while its "
+                "restart window is unverified — keep client summaries")
         for m in orderer.scriptorium.get_deltas(
                 tenant_id, document_id, base, 10**9):
             if m.type != MessageType.OPERATION:
@@ -134,11 +159,30 @@ class ServiceSummarizer:
                         "device does not model — keep client summaries")
                 if "attach" not in inner:
                     last_channel_seq = m.sequence_number
+                    if not first_channel_seq:
+                        first_channel_seq = m.sequence_number
+                    if gap_lo is not None and m.sequence_number > gap_lo \
+                            and (gap_hi is None
+                                 or m.sequence_number < gap_hi):
+                        raise RuntimeError(
+                            f"doc {tenant_id}/{document_id} has channel op "
+                            f"seq {m.sequence_number} sequenced in the "
+                            f"applier's restart window (checkpoint at "
+                            f"{gap_lo}, feed resumed at {gap_hi}): the "
+                            "restored state does not contain it")
         if applied < last_channel_seq:
             raise RuntimeError(
                 f"applier lags the stream for {tenant_id}/{document_id}: "
                 f"applied seq {applied} < last channel op "
                 f"{last_channel_seq}; feed the applier before summarizing")
+        if not anchored and first_channel_seq and \
+                self.applier.first_seq(tenant_id, document_id) \
+                > first_channel_seq:
+            raise RuntimeError(
+                f"applier for {tenant_id}/{document_id} started ingesting "
+                f"at seq {self.applier.first_seq(tenant_id, document_id)} "
+                f"but the doc's channel history starts at "
+                f"{first_channel_seq}: coverage is incomplete")
         if base > 0:
             # content below the base is only reachable through the prior
             # acked summary — it must not hold anything we would drop
@@ -162,14 +206,22 @@ class ServiceSummarizer:
     def summarize_all(self, tenant_id: str, documents: list[str],
                       min_seq: Optional[int] = None) -> int:
         """The batch pass (BASELINE config 5): one device fence, then a
-        decode+upload per doc. Returns the number summarized."""
+        decode+upload per doc. Returns the number summarized; docs the
+        refusal gate rejects are SKIPPED (recorded in ``self.refusals``),
+        not allowed to abort the rest of the fleet — they simply keep
+        client summaries."""
         self.applier.finalize()  # one fence for the whole batch
+        self.refusals: list[tuple[str, str, str]] = []
         n = 0
         for doc in documents:
             orderer = self.server._get_orderer(tenant_id, doc)
             if min_seq is not None and \
                     orderer.deli.sequence_number < min_seq:
                 continue
-            self.summarize_doc(tenant_id, doc)
+            try:
+                self.summarize_doc(tenant_id, doc)
+            except RuntimeError as e:
+                self.refusals.append((tenant_id, doc, str(e)))
+                continue
             n += 1
         return n

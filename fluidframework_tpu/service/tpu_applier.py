@@ -258,10 +258,21 @@ class TpuDocumentApplier:
         self.dispatches = 0
         self.ops_applied = 0
         self.host_escalations = 0
-        # highest ingested sequence number per slot — consumers that write
-        # summaries from device state (service_summarizer.py) compare this
-        # against the stream to refuse summarizing a lagging doc
+        # coverage tracking for summary writers (service_summarizer.py):
+        # _applied_seq = highest ingested seq per slot (tail-lag check);
+        # _first_seq = first ingested seq per slot; _anchored = slots whose
+        # state provably covers the doc's WHOLE history (checkpoint
+        # restore, authoritative escalation replay, or a summarizer gate
+        # pass over an untruncated log). max-seq alone cannot prove an
+        # applier fed only the post-truncation tail covers the prefix.
         self._applied_seq: dict[int, int] = {}
+        self._first_seq: dict[int, int] = {}
+        self._anchored: set[int] = set()
+        # checkpoint-restore bookkeeping: ops sequenced while the process
+        # was down are not in the restored state, so the summarizer must
+        # verify the feed resumed without skipping any (see restore_gap)
+        self._restore_applied: dict[int, int] = {}
+        self._post_restore_first: dict[int, int] = {}
         # async mode: a worker thread owns wave building + host→device
         # transfer + dispatch, so tunnel transfer latency never blocks the
         # ordering pipeline — the applier becomes a real pipeline stage
@@ -340,6 +351,10 @@ class TpuDocumentApplier:
             self._applied_seq[slot] = max(
                 self._applied_seq.get(slot, 0),
                 pairs[-1][0].sequence_number)
+            self._first_seq.setdefault(slot, pairs[0][0].sequence_number)
+            if slot in self._restore_applied:
+                self._post_restore_first.setdefault(
+                    slot, pairs[0][0].sequence_number)
         if slot in self._host_docs:
             for msg, wire_op in pairs:
                 self._apply_host(slot, msg, wire_op)
@@ -735,6 +750,36 @@ class TpuDocumentApplier:
         return self._applied_seq.get(
             self.slot_of(tenant_id, document_id), 0)
 
+    def first_seq(self, tenant_id: str, document_id: str) -> int:
+        """First sequence number ever ingested for the doc (0 if none)."""
+        return self._first_seq.get(
+            self.slot_of(tenant_id, document_id), 0)
+
+    def is_anchored(self, tenant_id: str, document_id: str) -> bool:
+        """True when the slot's state provably covers the doc's whole
+        history (see the coverage-tracking comment in __init__)."""
+        return self.slot_of(tenant_id, document_id) in self._anchored
+
+    def mark_anchored(self, tenant_id: str, document_id: str) -> None:
+        """Record a coverage proof established by the caller (the
+        summarizer's gate pass). Also discharges any pending
+        restore-window condition — the proof subsumes it."""
+        slot = self.slot_of(tenant_id, document_id)
+        self._anchored.add(slot)
+        self._restore_applied.pop(slot, None)
+        self._post_restore_first.pop(slot, None)
+
+    def restore_gap(self, tenant_id: str, document_id: str
+                    ) -> Optional[tuple[int, Optional[int]]]:
+        """(applied seq at checkpoint restore, first seq ingested since)
+        for a restored slot, else None. Ops sequenced in between were
+        never ingested — the summarizer refuses if the stream shows any."""
+        slot = self.slot_of(tenant_id, document_id)
+        if slot not in self._restore_applied:
+            return None
+        return (self._restore_applied[slot],
+                self._post_restore_first.get(slot))
+
     def get_properties_at(self, tenant_id: str, document_id: str,
                           pos: int) -> dict:
         """Properties of the visible character at ``pos`` (final
@@ -783,6 +828,11 @@ class TpuDocumentApplier:
                 replica.apply_msg(m, local=False)
         self._applied_seq[slot] = max(self._applied_seq.get(slot, 0),
                                       replica.tree.current_seq)
+        # deliberately NOT anchored: the applier cannot verify the replay
+        # source yielded the doc's whole history (a summary-aware source
+        # starts at the summary) — the summarizer gate must re-prove
+        # coverage before trusting this replica for a service summary
+        self._anchored.discard(slot)
         if msg is not None:
             self._apply_host(slot, msg, wire_op)
 
@@ -836,6 +886,8 @@ def save_applier_checkpoint(applier: "TpuDocumentApplier",
                            for k in applier._host_docs},
         "applied_seq": {str(k): v
                         for k, v in applier._applied_seq.items()},
+        "first_seq": {str(k): v for k, v in applier._first_seq.items()},
+        "anchored": sorted(applier._anchored),
     }
     np.savez_compressed(path + ".npz", **arrays)
     with open(path + ".json", "w") as f:
@@ -873,4 +925,13 @@ def load_applier_checkpoint(path: str, **applier_kwargs
             f"tpu-applier/{tenant_id}/{document_id}", snap)
     applier._applied_seq = {int(k): v for k, v in
                             meta.get("applied_seq", {}).items()}
+    applier._first_seq = {int(k): v for k, v in
+                          meta.get("first_seq", {}).items()}
+    # a checkpoint written by pre-coverage-tracking code carries no
+    # anchor set; such slots restore UNANCHORED and the summarizer
+    # refuses until coverage is re-proven — safe, never lossy
+    applier._anchored = set(meta.get("anchored", []))
+    # restored anchors are conditional: the summarizer additionally
+    # verifies no ops were sequenced in the restart window (restore_gap)
+    applier._restore_applied = dict(applier._applied_seq)
     return applier

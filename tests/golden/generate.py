@@ -1,0 +1,193 @@
+"""Generate the cross-round format-freeze fixtures (VERDICT r3 item 6).
+
+Run ONCE (from the repo root) at the round-4 format freeze:
+
+    python -m tests.golden.generate
+
+The committed outputs pin the round-3/4 on-disk and on-wire formats:
+
+- ``wire_frames.json``   exact byte encodings of the framed JSON protocol
+- ``messages.json``      encode_message bytes for every message shape
+- ``svclog/`` + ``blobs/``  a durable service log + chunk store from a
+  scripted session (ops, summary, checkpoints, retention metadata)
+- ``applier_ckpt.*``     a TPU-applier device-farm checkpoint
+- ``expected.json``      the semantic state the fixtures must reproduce
+
+``test_compat.py`` loads these with CURRENT code and asserts both
+byte-exact round-trips (wire/messages) and semantic restores (log,
+blobs, checkpoint). If a future round changes a format, it must either
+keep loading these files or ship an explicit migration + regenerate.
+"""
+
+import json
+import os
+import shutil
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def wire_frames() -> None:
+    from fluidframework_tpu.service.front_end import _encode_frame
+
+    frames = [
+        {"t": "connect", "tenant": "acme", "doc": "d1", "rid": 1,
+         "token": None, "details": {"mode": "write"}},
+        {"t": "connected", "rid": 1, "clientId": "c-1", "seq": 7,
+         "mode": "write", "maxMessageSize": 16384},
+        {"t": "submit", "ops": [{"clientSequenceNumber": 1,
+                                 "referenceSequenceNumber": 7,
+                                 "type": 0,
+                                 "contents": {"kind": "chanop",
+                                              "address": "default",
+                                              "contents": {
+                                                  "address": "text",
+                                                  "contents": {
+                                                      "type": 0, "pos": 0,
+                                                      "text": "hi"}}}}]},
+        {"t": "ops", "msgs": [{"sequenceNumber": 8,
+                               "minimumSequenceNumber": 7,
+                               "clientSequenceNumber": 1,
+                               "referenceSequenceNumber": 7,
+                               "clientId": "c-1", "type": 0,
+                               "contents": None, "timestamp": 0.0}]},
+        {"t": "signal", "signal": {"clientId": "c-1",
+                                   "content": {"ping": 1}}},
+        {"t": "nack", "nack": {"code": 413, "message": "too large"}},
+        {"t": "get_deltas", "tenant": "acme", "doc": "d1",
+         "from": 0, "to": 100, "rid": 2},
+        {"t": "error", "rid": 3, "message": "nope"},
+    ]
+    out = [{"frame": f, "hex": _encode_frame(f).hex()} for f in frames]
+    with open(os.path.join(HERE, "wire_frames.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+def messages() -> None:
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage, MessageType, Nack, NackErrorType,
+        SequencedDocumentMessage,
+    )
+    from fluidframework_tpu.service.deli import RawMessage
+    from fluidframework_tpu.protocol.serialization import encode_message
+
+    shapes = {
+        "sequenced_op": SequencedDocumentMessage(
+            sequence_number=42, minimum_sequence_number=40,
+            client_sequence_number=3, reference_sequence_number=41,
+            client_id="client-a", type=MessageType.OPERATION,
+            contents={"kind": "chanop", "address": "default",
+                      "contents": {"address": "text",
+                                   "contents": {"type": 1, "start": 0,
+                                                "end": 2}}},
+            timestamp=123.5),
+        "join": SequencedDocumentMessage(
+            sequence_number=1, minimum_sequence_number=0,
+            client_sequence_number=-1, reference_sequence_number=-1,
+            client_id=None, type=MessageType.CLIENT_JOIN,
+            contents={"clientId": "client-a", "detail": {"mode": "write"},
+                      "canEvict": True},
+            timestamp=1.0),
+        "raw": RawMessage(
+            tenant_id="acme", document_id="d1", client_id="client-a",
+            operation=DocumentMessage(
+                client_sequence_number=1, reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={"x": 1}),
+            timestamp=2.0),
+        "nack": Nack(
+            operation=DocumentMessage(
+                client_sequence_number=9, reference_sequence_number=8,
+                type=MessageType.OPERATION, contents=None),
+            sequence_number=-1, code=429,
+            type=NackErrorType.THROTTLING, message="rate"),
+    }
+    out = {k: encode_message(v).decode() for k, v in shapes.items()}
+    with open(os.path.join(HERE, "messages.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+def service_log() -> dict:
+    from fluidframework_tpu.driver import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+    from fluidframework_tpu.service import LocalServer
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    logdir = os.path.join(HERE, "svclog")
+    blobdir = os.path.join(HERE, "blobs")
+    for d in (logdir, blobdir):
+        shutil.rmtree(d, ignore_errors=True)
+
+    clock = [1000.0]
+    server = LocalServer(log=DurableLog(logdir), storage_dir=blobdir,
+                         clock=lambda: clock[0])
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    c2 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "golden ")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(7, "fixture")
+    s1.annotate_range(0, 6, {"bold": True})
+    s1.remove_text(0, 1)  # exercise remove + zamboni paths
+    sm = SummaryManager(c1, max_ops=10**9)
+    sm.summarize_now()
+    s2.insert_text(0, "post-summary ")  # tail beyond the summary
+    assert s1.get_text() == s2.get_text()
+    server.checkpoint_all()
+    server.log.sync()
+    expected = {
+        "text": s1.get_text(),
+        "seq": server._orderers["t/doc"].deli.sequence_number,
+        "summary_head": server._orderers["t/doc"].scribe.last_summary_head,
+        "bold_at_0_after_boot": False,  # 'g' was removed; 'o' is pos 0
+    }
+    server.log.close()
+    return expected
+
+
+def applier_checkpoint() -> dict:
+    from fluidframework_tpu.mergetree.client import MergeTreeClient
+    from fluidframework_tpu.protocol.messages import (
+        MessageType, SequencedDocumentMessage,
+    )
+    from fluidframework_tpu.service.tpu_applier import (
+        TpuDocumentApplier, save_applier_checkpoint,
+    )
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    oracle = MergeTreeClient("oracle")
+    ops = [
+        (0, {"type": 0, "pos": 0, "text": "device "}),
+        (0, {"type": 0, "pos": 7, "text": "state"}),
+        (1, {"type": 1, "start": 0, "end": 3}),
+        (0, {"type": 2, "start": 0, "end": 4, "props": {"em": True}}),
+    ]
+    for i, (kind, op) in enumerate(ops):
+        msg = SequencedDocumentMessage(
+            sequence_number=i + 1, minimum_sequence_number=i,
+            client_sequence_number=i + 1, reference_sequence_number=i,
+            client_id="gen", type=MessageType.OPERATION,
+            contents=op, timestamp=float(i))
+        applier.ingest("t", "ckdoc", msg, op)
+        oracle.apply_msg(msg, local=False)
+    applier.finalize()
+    save_applier_checkpoint(applier, os.path.join(HERE, "applier_ckpt"))
+    return {"ckpt_text": oracle.get_text(),
+            "ckpt_applied_seq": len(ops)}
+
+
+def main() -> None:
+    wire_frames()
+    messages()
+    expected = service_log()
+    expected.update(applier_checkpoint())
+    with open(os.path.join(HERE, "expected.json"), "w") as fh:
+        json.dump(expected, fh, indent=1)
+    print("golden fixtures written:", expected)
+
+
+if __name__ == "__main__":
+    main()

@@ -174,3 +174,148 @@ def test_summarize_refuses_non_modeled_content(server, loader):
     feed(applier2, server, "t", "dsdoc")
     with pytest.raises(RuntimeError, match="data store"):
         ServiceSummarizer(server, applier2).summarize_doc("t", "dsdoc")
+
+
+def test_summarize_refuses_unproven_prefix_coverage(tmp_path):
+    """Code-review r4 round 2: an applier fed only the post-truncation
+    TAIL passes a max-seq check but must still be refused — its state
+    does not provably contain the truncated prefix."""
+    from fluidframework_tpu.config import Config
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    cfg = Config().with_overrides(log_retention_ops=0)
+    server = LocalServer(config=cfg)
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "precious prefix ")
+    SummaryManager(c1, max_ops=10**9).summarize_now()  # truncates the log
+    s1.insert_text(0, "tail ")
+    orderer = server._get_orderer("t", "doc")
+    base = orderer.scriptorium.retained_base("t", "doc")
+    assert base > 0
+
+    # a FRESH applier that ingests only the retained tail
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    for m in channel_stream(server, "t", "doc", "default", "text",
+                            from_seq=base):
+        applier.ingest("t", "doc", m, m.contents)
+    svc = ServiceSummarizer(server, applier)
+    with pytest.raises(RuntimeError, match="not\\b.*anchored|anchored"):
+        svc.summarize_doc("t", "doc")
+    # and a batch pass SKIPS it instead of aborting
+    assert svc.summarize_all("t", ["doc"]) == 0
+    assert len(svc.refusals) == 1
+
+
+def test_summarize_refuses_gapped_genesis_feed(server, loader):
+    """Untruncated log, but the applier missed the doc's first channel
+    op: first-seq accounting must refuse."""
+    c1 = loader.resolve("t", "gapdoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "first")
+    s1.insert_text(5, " second")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    msgs = list(channel_stream(server, "t", "gapdoc", "default", "text"))
+    for m in msgs[1:]:  # skip the doc's first channel op
+        applier.ingest("t", "gapdoc", m, m.contents)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        ServiceSummarizer(server, applier).summarize_doc("t", "gapdoc")
+
+
+def test_anchored_applier_survives_own_truncation(tmp_path):
+    """The happy path across retention: a genesis-fed applier writes a
+    summary (gate pass anchors it), retention truncates, and a SECOND
+    service summary still commits."""
+    from fluidframework_tpu.config import Config
+
+    cfg = Config().with_overrides(log_retention_ops=0)
+    server = LocalServer(config=cfg)
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "one ")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "doc")
+    svc = ServiceSummarizer(server, applier)
+    v1 = svc.summarize_doc("t", "doc")  # anchors + truncates
+    assert server._get_orderer("t", "doc") \
+        .scriptorium.retained_base("t", "doc") > 0
+
+    s1.insert_text(0, "two ")
+    orderer = server._get_orderer("t", "doc")
+    base = orderer.scriptorium.retained_base("t", "doc")
+    for m in channel_stream(server, "t", "doc", "default", "text",
+                            from_seq=base):
+        applier.ingest("t", "doc", m, m.contents)
+    v2 = svc.summarize_doc("t", "doc")
+    assert v2 != v1
+    c2 = loader.resolve("t", "doc")
+    assert (c2.runtime.get_data_store("default").get_channel("text")
+            .get_text() == "two one ")
+
+
+def test_summarize_refuses_restart_window_gap(tmp_path):
+    """Code-review r4 round 3: a checkpoint-restored anchor is only
+    trustworthy if no channel op was sequenced while the process was
+    down — ops in the restart window are in the log but not in the
+    restored device state."""
+    from fluidframework_tpu.service.tpu_applier import (
+        load_applier_checkpoint,
+        save_applier_checkpoint,
+    )
+
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "before ")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "doc")
+    svc = ServiceSummarizer(server, applier)
+    svc.summarize_doc("t", "doc")  # anchors the slot
+    ckpt = str(tmp_path / "ck")
+    save_applier_checkpoint(applier, ckpt)
+
+    # "process death": ops sequenced while the applier is down
+    s1.insert_text(0, "downtime ")
+    applier2 = load_applier_checkpoint(ckpt, ops_per_dispatch=8)
+    applier2.set_replay_source(lambda t, d: [])
+    # the feed resumes LATE — only ops after another edit
+    s1.insert_text(0, "late ")
+    late_seq = max(m.sequence_number for m in channel_stream(
+        server, "t", "doc", "default", "text"))
+    for m in channel_stream(server, "t", "doc", "default", "text"):
+        if m.sequence_number >= late_seq:
+            applier2.ingest("t", "doc", m, m.contents)
+    svc2 = ServiceSummarizer(server, applier2)
+    with pytest.raises(RuntimeError, match="restart window"):
+        svc2.summarize_doc("t", "doc")
+
+    # a restore whose feed resumes cleanly (no window ops) is accepted
+    applier3 = load_applier_checkpoint(ckpt, ops_per_dispatch=8)
+    applier3.set_replay_source(lambda t, d: [])
+    ck_seq = applier3.applied_seq("t", "doc")
+    for m in channel_stream(server, "t", "doc", "default", "text"):
+        if m.sequence_number > ck_seq:
+            applier3.ingest("t", "doc", m, m.contents)
+    v = ServiceSummarizer(server, applier3).summarize_doc("t", "doc")
+    assert v is not None
+    c2 = loader.resolve("t", "doc")
+    assert (c2.runtime.get_data_store("default").get_channel("text")
+            .get_text() == "late downtime before ")
