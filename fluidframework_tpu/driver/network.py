@@ -21,6 +21,7 @@ import socket
 import threading
 from typing import Any, Callable, Optional
 
+from ..protocol import binwire
 from ..protocol.serialization import message_from_dict, message_to_dict
 from .definitions import (
     DocumentDeltaConnection,
@@ -46,6 +47,8 @@ class _Transport:
         self._pending: dict[int, dict] = {}  # rid → reply frame
         self._pending_cv = threading.Condition()
         self._push_handlers: dict[str, Callable[[dict], None]] = {}
+        # binary ops batches bypass the dict layer entirely
+        self.on_binary_ops: Optional[Callable[[list], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._closed = False
         self._reader = threading.Thread(
@@ -55,7 +58,10 @@ class _Transport:
     # ------------------------------------------------------------- sending
 
     def send(self, frame: dict) -> None:
-        body = json.dumps(frame, separators=(",", ":")).encode()
+        self.send_body(json.dumps(frame, separators=(",", ":")).encode())
+
+    def send_body(self, body: bytes) -> None:
+        """Send a length-prefix-framed body (JSON or binwire)."""
         with self._wlock:
             self.sock.sendall(len(body).to_bytes(4, "big") + body)
 
@@ -100,6 +106,13 @@ class _Transport:
                 body = self._recv_exactly(int.from_bytes(header, "big"))
                 if body is None:
                     break
+                if binwire.is_binary(body):
+                    cb = self.on_binary_ops
+                    if cb is not None:
+                        _, msgs = binwire.decode_ops(body)
+                        with self.lock:
+                            cb(msgs)
+                    continue
                 frame = json.loads(body.decode())
                 rid = frame.get("rid")
                 if rid is not None:
@@ -140,9 +153,10 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
     def __init__(self, transport: _Transport, tenant_id: str,
                  document_id: str, details: Any = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, binary: bool = True):
         self._t = transport
         self.lock = transport.lock
+        self._binary = binary
         self._handlers: dict[str, Optional[Callable]] = {
             "op": None, "nack": None, "signal": None}
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
@@ -153,7 +167,12 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             for d in f["msgs"]:
                 self._deliver("op", message_from_dict(d))
 
+        def on_binary_ops(msgs):
+            for m in msgs:
+                self._deliver("op", m)
+
         transport.on_push("ops", on_ops)
+        transport.on_binary_ops = on_binary_ops
         transport.on_push("op", lambda f: self._deliver(
             "op", message_from_dict(f["msg"])))
         transport.on_push("nack", lambda f: self._deliver(
@@ -163,7 +182,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         transport.on_disconnect = self._fire_disconnect
         reply = transport.request({
             "t": "connect", "tenant": tenant_id, "doc": document_id,
-            "details": details, "token": token})
+            "details": details, "token": token,
+            "bin": 1 if binary else 0})
         self.client_id = reply["clientId"]
         self.initial_sequence_number = reply["seq"]
         self.mode = reply.get("mode", "write")
@@ -193,8 +213,11 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
     def submit(self, messages) -> None:
         with self._t.lock:
-            self._t.send({"t": "submit",
-                          "ops": [message_to_dict(m) for m in messages]})
+            if self._binary:
+                self._t.send_body(binwire.encode_submit(messages))
+            else:
+                self._t.send({"t": "submit",
+                              "ops": [message_to_dict(m) for m in messages]})
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})
@@ -285,11 +308,13 @@ class NetworkDocumentService(DocumentService):
     reference's socket + REST split."""
 
     def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
-                 timeout: float = 30.0, token_provider=None):
+                 timeout: float = 30.0, token_provider=None,
+                 binary: bool = True):
         self._host, self._port, self._timeout = host, port, timeout
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
+        self._binary = binary
         self._rpc: Optional[_Transport] = None
 
     def _rpc_transport(self) -> _Transport:
@@ -302,7 +327,7 @@ class NetworkDocumentService(DocumentService):
         token = (self._token_provider(self._tenant, self._doc)
                  if self._token_provider else None)
         return NetworkDeltaConnection(t, self._tenant, self._doc, details,
-                                      token=token)
+                                      token=token, binary=self._binary)
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
         return NetworkDeltaStorage(self._rpc_transport(), self._tenant,
@@ -319,13 +344,14 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
     routerlicious-driver tokens.ts TokenProvider)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 token_provider=None):
+                 token_provider=None, binary: bool = True):
         self._host, self._port, self._timeout = host, port, timeout
         self._token_provider = token_provider
+        self._binary = binary
 
     def create_document_service(
         self, tenant_id: str, document_id: str
     ) -> NetworkDocumentService:
         return NetworkDocumentService(
             self._host, self._port, tenant_id, document_id, self._timeout,
-            token_provider=self._token_provider)
+            token_provider=self._token_provider, binary=self._binary)

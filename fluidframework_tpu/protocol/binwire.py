@@ -1,0 +1,382 @@
+"""Binary wire codec for the HOT frames of the socket protocol.
+
+Ref: the reference ships every socket payload as JSON over socket.io
+(driver-base/src/documentDeltaConnection.ts:53, alfred index.ts:310);
+at the round-3 measured knee the front end spent its whole budget in
+per-frame ``json.loads``/``dumps`` (submit→deli p99 5.3 ms of 5.9 total).
+SURVEY §2.9 prescribes a binary front end for exactly this reason. This
+module is the TPU-first answer: the two frames that carry the op volume
+(client submit boxcars and sequenced broadcast batches) get a
+struct-packed encoding; everything else (connect, signals, storage RPCs)
+stays JSON.
+
+Frame discrimination needs no negotiation on the READ side: JSON bodies
+start with ``{`` (0x7B), binary bodies with MAGIC (0x01). The 4-byte
+length header is shared with the JSON framing (front_end.py docstring).
+
+Layout (all integers big-endian):
+
+    body   := MAGIC ftype hdr(ftype) batch
+    MAGIC  := 0x01
+    ftype  := 1 submit | 2 ops | 3 fsubmit | 4 fops
+    hdr    := ""                       (submit, ops)
+            | u32 sid                  (fsubmit)
+            | u16 len + utf8 topic     (fops)
+    batch  := pool recs
+    pool   := u16 n; n × (u16 len + utf8)     -- interned strings
+    recs   := u16 n; n × rec
+
+The batch section is IDENTICAL across the four frame types — that is the
+load-bearing property: a gateway converts a client ``submit`` into an
+upstream ``fsubmit`` by prepending 6 bytes to the received body, and a
+core ``fops`` into a client ``ops`` by slicing the topic header off,
+relaying op payloads it never decodes (gateway.py).
+
+rec (submit: DocumentMessage):
+
+    i32 cseq, i32 rseq, traces, u8 kind, payload(kind)
+
+rec (ops: SequencedDocumentMessage):
+
+    u16 client_id_idx (0xFFFF = None), i64 seq, i64 msn,
+    i32 cseq, i32 rseq, f64 timestamp, traces, u8 kind, payload(kind)
+
+    traces := u8 n; n × (u16 svc_idx, u16 act_idx, f64 ts)
+
+kind encodes the merge-tree chanop fast path — the envelope
+``{"kind": "chanop", "address": ds, "contents": {"address": ch,
+"contents": op}}`` (runtime/datastore.py wire shape) collapses to
+interned addresses + fixed fields:
+
+    0 insert   := u16 ds_idx, u16 ch_idx, u32 pos, u16 len + utf8 text
+    1 remove   := u16 ds_idx, u16 ch_idx, u32 start, u32 end
+    2 annotate := u16 ds_idx, u16 ch_idx, u32 start, u32 end,
+                  u16 len + utf8 props-JSON
+    255 generic:= u32 len + utf8 JSON of the non-fixed message fields
+                  ({type, contents, metadata[, origin]}) — ANY message
+                  round-trips; the fast kinds are an optimization, not a
+                  constraint (test_binwire fuzzes both against the JSON
+                  codec for equality).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional
+
+from .messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+    TraceHop,
+)
+
+MAGIC = 0x01
+FT_SUBMIT = 1
+FT_OPS = 2
+FT_FSUBMIT = 3
+FT_FOPS = 4
+
+_U16 = struct.Struct(">H")
+_U32 = struct.Struct(">I")
+_DOC_FIXED = struct.Struct(">ii")           # cseq, rseq
+_SEQ_FIXED = struct.Struct(">Hqqiid")       # cid_idx, seq, msn, cseq, rseq, ts
+_TRACE = struct.Struct(">HHd")              # svc_idx, act_idx, ts
+_INS_HDR = struct.Struct(">HHI")            # ds, ch, pos
+_SPAN = struct.Struct(">HHII")              # ds, ch, start, end
+_FSUB_HDR = struct.Struct(">BBI")           # magic, ftype, sid
+
+_NONE_IDX = 0xFFFF
+_MAX_U32 = 0xFFFFFFFF
+
+_OP_TYPE = MessageType.OPERATION
+
+
+class _Pool:
+    """Build-side string interner for the frame's string pool."""
+
+    __slots__ = ("idx", "items")
+
+    def __init__(self):
+        self.idx: dict[str, int] = {}
+        self.items: list[bytes] = []
+
+    def add(self, s: str) -> int:
+        i = self.idx.get(s)
+        if i is None:
+            i = len(self.items)
+            if i >= _NONE_IDX:
+                raise ValueError("string pool overflow")
+            self.idx[s] = i
+            self.items.append(s.encode())
+        return i
+
+    def dump(self) -> bytes:
+        out = [_U16.pack(len(self.items))]
+        for b in self.items:
+            out.append(_U16.pack(len(b)))
+            out.append(b)
+        return b"".join(out)
+
+
+def _chanop_parts(contents) -> Optional[tuple]:
+    """(ds, ch, op) if contents is a plain chanop envelope, else None."""
+    if type(contents) is not dict or contents.get("kind") != "chanop":
+        return None
+    ds = contents.get("address")
+    inner = contents.get("contents")
+    if (type(ds) is not str or type(inner) is not dict
+            or len(contents) != 3 or len(inner) != 2):
+        return None
+    ch = inner.get("address")
+    op = inner.get("contents")
+    if type(ch) is not str or type(op) is not dict:
+        return None
+    return ds, ch, op
+
+
+def _u32_ok(*vals) -> bool:
+    for v in vals:
+        if type(v) is not int or v < 0 or v > _MAX_U32:
+            return False
+    return True
+
+
+def _encode_payload(pool: _Pool, out: list, type_, contents, metadata,
+                    origin=None) -> None:
+    """Append ``u8 kind + payload`` for one message's variable part."""
+    if type_ is _OP_TYPE and metadata is None and origin is None:
+        parts = _chanop_parts(contents)
+        if parts is not None:
+            ds, ch, op = parts
+            t = op.get("type")
+            if t == 0 and len(op) == 3:
+                text = op.get("text")
+                pos = op.get("pos")
+                if type(text) is str and _u32_ok(pos):
+                    tb = text.encode()
+                    if len(tb) <= 0xFFFF:
+                        out.append(b"\x00")
+                        out.append(_INS_HDR.pack(pool.add(ds), pool.add(ch),
+                                                 pos))
+                        out.append(_U16.pack(len(tb)))
+                        out.append(tb)
+                        return
+            elif t == 1 and len(op) == 3:
+                start, end = op.get("start"), op.get("end")
+                if _u32_ok(start, end):
+                    out.append(b"\x01")
+                    out.append(_SPAN.pack(pool.add(ds), pool.add(ch),
+                                          start, end))
+                    return
+            elif t == 2 and len(op) == 4 and type(op.get("props")) is dict:
+                start, end = op.get("start"), op.get("end")
+                if _u32_ok(start, end):
+                    pb = json.dumps(op["props"],
+                                    separators=(",", ":")).encode()
+                    if len(pb) <= 0xFFFF:
+                        out.append(b"\x02")
+                        out.append(_SPAN.pack(pool.add(ds), pool.add(ch),
+                                              start, end))
+                        out.append(_U16.pack(len(pb)))
+                        out.append(pb)
+                        return
+    # generic fallback: the non-fixed fields as JSON
+    d = {"type": type_, "contents": contents, "metadata": metadata}
+    if origin is not None:
+        d["origin"] = origin
+    gb = json.dumps(d, separators=(",", ":")).encode()
+    out.append(b"\xff")
+    out.append(_U32.pack(len(gb)))
+    out.append(gb)
+
+
+def _encode_traces(pool: _Pool, out: list, traces) -> None:
+    n = len(traces)
+    if n > 0xFF:  # absurd, but stay correct
+        traces = traces[-0xFF:]
+        n = 0xFF
+    out.append(bytes((n,)))
+    for t in traces:
+        out.append(_TRACE.pack(pool.add(t.service), pool.add(t.action),
+                               t.timestamp))
+
+
+def encode_submit(ops: list[DocumentMessage], *, sid: Optional[int] = None,
+                  ) -> bytes:
+    """Encode a submit boxcar body (``fsubmit`` when ``sid`` is given)."""
+    pool = _Pool()
+    recs: list = [_U16.pack(len(ops))]
+    for m in ops:
+        recs.append(_DOC_FIXED.pack(m.client_sequence_number,
+                                    m.reference_sequence_number))
+        _encode_traces(pool, recs, m.traces)
+        _encode_payload(pool, recs, m.type, m.contents, m.metadata)
+    hdr = (bytes((MAGIC, FT_SUBMIT)) if sid is None
+           else _FSUB_HDR.pack(MAGIC, FT_FSUBMIT, sid))
+    return hdr + pool.dump() + b"".join(recs)
+
+
+def encode_ops(msgs: list[SequencedDocumentMessage], *,
+               topic: Optional[str] = None) -> bytes:
+    """Encode a sequenced broadcast batch body (``fops`` with a topic)."""
+    pool = _Pool()
+    recs: list = [_U16.pack(len(msgs))]
+    for m in msgs:
+        cid = m.client_id
+        recs.append(_SEQ_FIXED.pack(
+            _NONE_IDX if cid is None else pool.add(cid),
+            m.sequence_number, m.minimum_sequence_number,
+            m.client_sequence_number, m.reference_sequence_number,
+            m.timestamp))
+        _encode_traces(pool, recs, m.traces)
+        _encode_payload(pool, recs, m.type, m.contents, m.metadata, m.origin)
+    if topic is None:
+        hdr = bytes((MAGIC, FT_OPS))
+    else:
+        tb = topic.encode()
+        hdr = bytes((MAGIC, FT_FOPS)) + _U16.pack(len(tb)) + tb
+    return hdr + pool.dump() + b"".join(recs)
+
+
+# ---------------------------------------------------------------- decoding
+
+
+def _read_pool(body: bytes, off: int) -> tuple[list[str], int]:
+    (n,) = _U16.unpack_from(body, off)
+    off += 2
+    pool = []
+    for _ in range(n):
+        (ln,) = _U16.unpack_from(body, off)
+        off += 2
+        pool.append(body[off:off + ln].decode())
+        off += ln
+    return pool, off
+
+
+def _read_traces(body: bytes, off: int, pool: list[str]
+                 ) -> tuple[list[TraceHop], int]:
+    n = body[off]
+    off += 1
+    traces = []
+    for _ in range(n):
+        svc, act, ts = _TRACE.unpack_from(body, off)
+        off += _TRACE.size
+        traces.append(TraceHop(service=pool[svc], action=pool[act],
+                               timestamp=ts))
+    return traces, off
+
+
+def _read_payload(body: bytes, off: int, pool: list[str]) -> tuple:
+    """Returns (type, contents, metadata, origin, new_off)."""
+    kind = body[off]
+    off += 1
+    if kind == 0:
+        ds, ch, pos = _INS_HDR.unpack_from(body, off)
+        off += _INS_HDR.size
+        (ln,) = _U16.unpack_from(body, off)
+        off += 2
+        text = body[off:off + ln].decode()
+        off += ln
+        op = {"type": 0, "pos": pos, "text": text}
+    elif kind == 1:
+        ds, ch, start, end = _SPAN.unpack_from(body, off)
+        off += _SPAN.size
+        op = {"type": 1, "start": start, "end": end}
+    elif kind == 2:
+        ds, ch, start, end = _SPAN.unpack_from(body, off)
+        off += _SPAN.size
+        (ln,) = _U16.unpack_from(body, off)
+        off += 2
+        op = {"type": 2, "start": start, "end": end,
+              "props": json.loads(body[off:off + ln])}
+        off += ln
+    elif kind == 0xFF:
+        (ln,) = _U32.unpack_from(body, off)
+        off += 4
+        d = json.loads(body[off:off + ln])
+        off += ln
+        return (MessageType(d["type"]), d.get("contents"),
+                d.get("metadata"), d.get("origin"), off)
+    else:
+        raise ValueError(f"unknown binwire payload kind {kind}")
+    contents = {"kind": "chanop", "address": pool[ds],
+                "contents": {"address": pool[ch], "contents": op}}
+    return _OP_TYPE, contents, None, None, off
+
+
+def decode_submit(body: bytes) -> tuple[Optional[int], list[DocumentMessage]]:
+    """Decode a submit/fsubmit body → (sid or None, ops)."""
+    ftype = body[1]
+    if ftype == FT_FSUBMIT:
+        (sid,) = _U32.unpack_from(body, 2)
+        off = _FSUB_HDR.size
+    else:
+        sid, off = None, 2
+    pool, off = _read_pool(body, off)
+    (n,) = _U16.unpack_from(body, off)
+    off += 2
+    ops = []
+    for _ in range(n):
+        cseq, rseq = _DOC_FIXED.unpack_from(body, off)
+        off += _DOC_FIXED.size
+        traces, off = _read_traces(body, off, pool)
+        type_, contents, metadata, _, off = _read_payload(body, off, pool)
+        ops.append(DocumentMessage(
+            client_sequence_number=cseq, reference_sequence_number=rseq,
+            type=type_, contents=contents, metadata=metadata, traces=traces))
+    return sid, ops
+
+
+def decode_ops(body: bytes) -> tuple[Optional[str],
+                                     list[SequencedDocumentMessage]]:
+    """Decode an ops/fops body → (topic or None, msgs)."""
+    ftype = body[1]
+    if ftype == FT_FOPS:
+        (tl,) = _U16.unpack_from(body, 2)
+        topic = body[4:4 + tl].decode()
+        off = 4 + tl
+    else:
+        topic, off = None, 2
+    pool, off = _read_pool(body, off)
+    (n,) = _U16.unpack_from(body, off)
+    off += 2
+    msgs = []
+    for _ in range(n):
+        cid_idx, seq, msn, cseq, rseq, ts = _SEQ_FIXED.unpack_from(body, off)
+        off += _SEQ_FIXED.size
+        traces, off = _read_traces(body, off, pool)
+        type_, contents, metadata, origin, off = _read_payload(body, off, pool)
+        msgs.append(SequencedDocumentMessage(
+            client_id=None if cid_idx == _NONE_IDX else pool[cid_idx],
+            sequence_number=seq, minimum_sequence_number=msn,
+            client_sequence_number=cseq, reference_sequence_number=rseq,
+            type=type_, contents=contents, metadata=metadata, origin=origin,
+            timestamp=ts, traces=traces))
+    return topic, msgs
+
+
+# --------------------------------------------------- gateway byte rewrites
+# The relay operations gateway.py performs WITHOUT decoding op payloads.
+
+
+def submit_to_fsubmit(body: bytes, sid: int) -> bytes:
+    """Rewrite a client ``submit`` body into an upstream ``fsubmit``."""
+    return _FSUB_HDR.pack(MAGIC, FT_FSUBMIT, sid) + body[2:]
+
+
+def fops_strip_topic(body: bytes) -> tuple[str, bytes]:
+    """Split an ``fops`` body → (topic, client-facing ``ops`` body)."""
+    (tl,) = _U16.unpack_from(body, 2)
+    topic = body[4:4 + tl].decode()
+    return topic, bytes((MAGIC, FT_OPS)) + body[4 + tl:]
+
+
+def is_binary(body: bytes) -> bool:
+    return bool(body) and body[0] == MAGIC
+
+
+def frame(body: bytes) -> bytes:
+    """Prepend the shared 4-byte length header."""
+    return len(body).to_bytes(4, "big") + body

@@ -55,6 +55,7 @@ import json
 import threading
 from typing import Any, Optional
 
+from ..protocol import binwire
 from ..protocol.messages import (
     DocumentMessage,
     MessageType,
@@ -73,7 +74,8 @@ def _encode_frame(obj: dict) -> bytes:
     return len(body).to_bytes(4, "big") + body
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+async def _read_body(reader: asyncio.StreamReader) -> Optional[bytes]:
+    """Read one length-prefixed frame body (JSON or binary), None on EOF."""
     try:
         header = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -82,10 +84,14 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     if n > MAX_FRAME:
         raise ValueError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
     try:
-        body = await reader.readexactly(n)
+        return await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
-    return json.loads(body.decode())
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    body = await _read_body(reader)
+    return None if body is None else json.loads(body.decode())
 
 
 class _ClientSession:
@@ -96,6 +102,8 @@ class _ClientSession:
         self.front = front
         self.writer = writer
         self.conn: Optional[ServerConnection] = None
+        self.binary = False       # client opted into binary ops push
+        self._fbinary = False     # gateway opted into binary fops push
         self._dropping = False
         self._loop = asyncio.get_running_loop()
         # gateway-mode state: sid → ServerConnection, and the doc topics
@@ -149,16 +157,23 @@ class _ClientSession:
         The broadcaster delivers the same batch object to every session
         of the doc back to back; a one-entry cache on the front end keyed
         by (doc, first seq, len) — unique in an append-only stream —
-        turns per-subscriber JSON encoding into a single encode + N raw
-        writes."""
+        turns per-subscriber encoding into a single encode + N raw
+        writes. Binary-negotiated sessions get the binwire encoding from
+        a second cache (a JSON and a binary client can share a doc)."""
         conn = self.conn
         key = (conn.tenant_id, conn.document_id,
                batch[0].sequence_number, len(batch))
-        cached_key, raw = self.front._batch_cache
-        if cached_key != key:
-            raw = _encode_frame(
-                {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
-            self.front._batch_cache = (key, raw)
+        if self.binary:
+            cached_key, raw = self.front._batch_cache_bin
+            if cached_key != key:
+                raw = binwire.frame(binwire.encode_ops(batch))
+                self.front._batch_cache_bin = (key, raw)
+        else:
+            cached_key, raw = self.front._batch_cache
+            if cached_key != key:
+                raw = _encode_frame(
+                    {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
+                self.front._batch_cache = (key, raw)
         self.push_raw(raw)
 
     def push_raw(self, raw: bytes) -> None:
@@ -185,6 +200,7 @@ class _ClientSession:
                     frame["tenant"], frame["doc"], frame.get("details"),
                     token=frame.get("token"))
                 self.conn = conn
+                self.binary = bool(frame.get("bin"))
                 # a broadcast batch rides the wire as ONE frame — at load
                 # the per-op frame overhead (json + syscall each) was the
                 # front end's dominant cost
@@ -252,6 +268,62 @@ class _ClientSession:
                                     message=str(e))
             self.push("error", {"rid": rid, "message": str(e)})
 
+    def handle_binary(self, body: bytes) -> None:
+        """Dispatch a binwire frame: the hot submit path (direct and
+        gateway-muxed). Connect/signals/storage stay on the JSON path."""
+        try:
+            ftype = body[1]
+            if ftype == binwire.FT_SUBMIT:
+                if self.conn is None:
+                    raise RuntimeError("submit before connect")
+                _, ops = binwire.decode_submit(body)
+                ops = self._filter_oversized(ops, len(body), None)
+                if ops:
+                    self.conn.submit(ops)
+            elif ftype == binwire.FT_FSUBMIT:
+                sid, ops = binwire.decode_submit(body)
+                conn = self._fsessions[sid]
+                ops = self._filter_oversized(ops, len(body), sid)
+                if ops:
+                    conn.submit(ops)
+            else:
+                raise ValueError(f"unexpected binary frame type {ftype}")
+        except Exception as e:  # noqa: BLE001 — report, don't kill the loop
+            self.front.logger.error("frame_error", frame_type="binary",
+                                    message=str(e))
+            self.push("error", {"message": str(e)})
+
+    def _filter_oversized(self, ops: list, body_len: int, sid) -> list:
+        """Enforce the per-op service limit on binary boxcars.
+
+        The limit is DEFINED as JSON size (the JSON door's measure, so
+        one op is admitted or nacked identically through either door).
+        Binwire is more compact than JSON — JSON escaping can double a
+        payload and the envelope keys add ~200 bytes — so the
+        skip-the-per-op-measurement fast path needs a conservative bound:
+        a whole boxcar body under (limit - 512) / 2 cannot contain an op
+        whose JSON measure exceeds the limit. Typical boxcars (KBs) pass
+        in one comparison; only outsized frames pay per-op JSON dumps."""
+        limit = self.front.max_message_size
+        if 2 * body_len + 512 <= limit:
+            return ops
+        kept = []
+        for op in ops:
+            d = message_to_dict(op)
+            if len(json.dumps(d).encode()) > limit:
+                nack = Nack(
+                    operation=op, sequence_number=-1, code=413,
+                    type=NackErrorType.BAD_REQUEST,
+                    message=f"message exceeds {limit} byte limit")
+                if sid is None:
+                    self.push("nack", {"nack": message_to_dict(nack)})
+                else:
+                    self.push("fnack", {"sid": sid,
+                                        "nack": message_to_dict(nack)})
+            else:
+                kept.append(op)
+        return kept
+
     def _handle_gateway(self, t: str, frame: dict, rid) -> None:
         """Backbone mux for a gateway connection (see module docstring).
 
@@ -275,15 +347,28 @@ class _ClientSession:
                 server.tenants.validate(frame.get("token"), tenant, doc,
                                         required_scope=SCOPE_READ)
             topic = BroadcasterLambda.topic(tenant, doc)
+            self._fbinary = bool(frame.get("bin"))
             # the gateway's topic subscription must exist BEFORE the join
             # is ordered: connect() sequences + broadcasts the join
             # synchronously, and a lone client that misses its own join
             # never activates (nothing later triggers gap repair)
             if topic not in self._ftopics:
-                def on_batch(batch, topic=topic):
-                    self.push("fops", {
-                        "topic": topic,
-                        "msgs": [message_to_dict(m) for m in batch]})
+                if self._fbinary:
+                    def on_batch(batch, topic=topic):
+                        # one binwire encode per batch, shared across
+                        # gateways via the front-end fops cache
+                        key = (topic, batch[0].sequence_number, len(batch))
+                        ck, raw = self.front._fops_cache
+                        if ck != key:
+                            raw = binwire.frame(
+                                binwire.encode_ops(batch, topic=topic))
+                            self.front._fops_cache = (key, raw)
+                        self.push_raw(raw)
+                else:
+                    def on_batch(batch, topic=topic):
+                        self.push("fops", {
+                            "topic": topic,
+                            "msgs": [message_to_dict(m) for m in batch]})
                 server.pubsub.subscribe(topic, on_batch)
 
                 def on_signal(sig, topic=topic):
@@ -428,6 +513,8 @@ class NetworkFrontEnd:
             max_message_size if max_message_size is not None
             else self.server.config.max_message_size)
         self._batch_cache: tuple = (None, b"")
+        self._batch_cache_bin: tuple = (None, b"")
+        self._fops_cache: tuple = (None, b"")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
@@ -444,10 +531,13 @@ class NetworkFrontEnd:
         session = _ClientSession(self, writer)
         try:
             while True:
-                frame = await _read_frame(reader)
-                if frame is None:
+                body = await _read_body(reader)
+                if body is None:
                     break
-                session.handle(frame)
+                if binwire.is_binary(body):
+                    session.handle_binary(body)
+                else:
+                    session.handle(json.loads(body.decode()))
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass  # malformed stream: drop the connection

@@ -36,7 +36,8 @@ import json
 import socket as _socket
 from typing import Optional
 
-from .front_end import _encode_frame, _read_frame
+from ..protocol import binwire
+from .front_end import _encode_frame, _read_body
 
 
 class _GatewaySession:
@@ -47,6 +48,7 @@ class _GatewaySession:
         self.writer = writer
         self.sid: Optional[int] = None
         self.topic: Optional[str] = None
+        self.binary = False  # client negotiated binwire ops push
         # While a connect awaits the core's auth verdict, broadcasts are
         # held here instead of the socket; flushed on success, dropped on
         # refusal. None = no gate (normal delivery).
@@ -75,6 +77,7 @@ class _GatewaySession:
             if self.sid is not None:
                 self.detach()
             self.sid = next(gw.sid_counter)
+            self.binary = bool(frame.get("bin"))
             self.topic = f"{frame['tenant']}/{frame['doc']}"
             # Register NOW (the core broadcasts this client's own join
             # synchronously with the fconnect — miss it and the client
@@ -87,11 +90,15 @@ class _GatewaySession:
             gw.sessions[self.sid] = self
             gw.topic_sessions.setdefault(self.topic, set()).add(self)
             try:
+                # the gateway ALWAYS asks the core for binary fops — it
+                # relays them to binary clients by byte-slicing and
+                # re-encodes JSON locally for legacy clients, keeping the
+                # expensive per-op encode off the core either way
                 reply = await gw.upstream_request({
                     "t": "fconnect", "sid": self.sid,
                     "tenant": frame["tenant"], "doc": frame["doc"],
                     "details": frame.get("details"),
-                    "token": frame.get("token")})
+                    "token": frame.get("token"), "bin": 1})
             except BaseException:
                 self._gate_buffer = None
                 self.detach()
@@ -162,6 +169,9 @@ class Gateway:
     def upstream_send(self, obj: dict) -> None:
         self._up_writer.write(_encode_frame(obj))
 
+    def upstream_send_raw(self, raw: bytes) -> None:
+        self._up_writer.write(raw)
+
     async def upstream_request(self, obj: dict) -> dict:
         rid = next(self._rid_counter)
         fut = asyncio.get_running_loop().create_future()
@@ -175,10 +185,13 @@ class Gateway:
     async def _upstream_loop(self, reader: asyncio.StreamReader) -> None:
         try:
             while True:
-                frame = await _read_frame(reader)
-                if frame is None:
+                body = await _read_body(reader)
+                if body is None:
                     break
-                self._dispatch_upstream(frame)
+                if binwire.is_binary(body):
+                    self._dispatch_upstream_binary(body)
+                else:
+                    self._dispatch_upstream(json.loads(body.decode()))
         finally:
             # core gone: every client of this gateway is dead too
             for session in list(self.sessions.values()):
@@ -189,6 +202,25 @@ class Gateway:
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("core disconnected"))
+
+    def _dispatch_upstream_binary(self, body: bytes) -> None:
+        """Relay a binary fops batch: byte-slice for binary clients (no
+        decode), one lazy JSON re-encode for any legacy client."""
+        topic, client_body = binwire.fops_strip_topic(body)
+        raw = binwire.frame(client_body)
+        json_raw = None
+        for session in self.topic_sessions.get(topic, ()):
+            if session.binary:
+                session.push_raw(raw)
+            else:
+                if json_raw is None:
+                    from ..protocol.serialization import message_to_dict
+
+                    _, msgs = binwire.decode_ops(client_body)
+                    json_raw = _encode_frame(
+                        {"t": "ops",
+                         "msgs": [message_to_dict(m) for m in msgs]})
+                session.push_raw(json_raw)
 
     def _dispatch_upstream(self, frame: dict) -> None:
         rid = frame.get("rid")
@@ -222,9 +254,22 @@ class Gateway:
         session = _GatewaySession(self, writer)
         try:
             while True:
-                frame = await _read_frame(reader)
-                if frame is None:
+                body = await _read_body(reader)
+                if body is None:
                     break
+                if binwire.is_binary(body):
+                    # hot path: rewrite submit → fsubmit by prepending the
+                    # sid — op payloads are relayed, never decoded here
+                    if (len(body) >= 2 and body[1] == binwire.FT_SUBMIT
+                            and session.sid is not None):
+                        self.upstream_send_raw(binwire.frame(
+                            binwire.submit_to_fsubmit(body, session.sid)))
+                    else:
+                        session.push({"t": "error",
+                                      "message": "unexpected binary frame"})
+                    await writer.drain()
+                    continue
+                frame = json.loads(body.decode())
                 try:
                     await session.handle(frame)
                 except (RuntimeError, ConnectionError) as e:

@@ -297,3 +297,27 @@ def test_reconnect_rebase_through_gateway(topology):
     assert wait_for(lambda: s2.get_text() == "baseY")
     c1.reconnect()
     assert wait_for(lambda: s1.get_text() == s2.get_text() == "XbaseY")
+
+
+def test_mixed_protocol_clients_through_gateway(topology):
+    """A binwire client and a JSON client on the SAME gateway converge:
+    the gateway byte-slices fops for the binary session and re-encodes
+    JSON once for the legacy one (gateway._dispatch_upstream_binary)."""
+    _, p1, _ = topology
+    lb = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1, binary=True))
+    lj = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1, binary=False))
+    cb = lb.resolve("t", "gwmix")
+    cj = lj.resolve("t", "gwmix")
+    sb = cb.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    sb.insert_text(0, "binary")
+
+    def synced():
+        ds = cj.runtime.data_stores.get("default")
+        return (ds is not None and "text" in ds.channels
+                and ds.get_channel("text").get_text() == "binary")
+    assert wait_for(synced)
+    sj = cj.runtime.get_data_store("default").get_channel("text")
+    sj.insert_text(0, "json+")
+    assert wait_for(lambda: sb.get_text() == "json+binary"
+                    and sj.get_text() == "json+binary")

@@ -238,3 +238,24 @@ def test_full_dds_catalog_over_the_wire():
         assert item is not None
         q2.complete(item)
         assert wait_for(lambda: len(ds1.get_channel("work")) == 0)
+
+
+def test_json_and_binary_clients_interoperate(front_end):
+    """A legacy JSON client and a binwire client share a doc: the front
+    end keeps per-protocol broadcast caches and both converge (the JSON
+    wire format stays frozen — tests/golden pins it)."""
+    lb = Loader(NetworkDocumentServiceFactory("127.0.0.1", front_end.port,
+                                              binary=True))
+    lj = Loader(NetworkDocumentServiceFactory("127.0.0.1", front_end.port,
+                                              binary=False))
+    cb = lb.resolve("t", "mixdoc")
+    cj = lj.resolve("t", "mixdoc")
+    sb = cb.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    sb.insert_text(0, "from-binary")
+    assert wait_for(lambda: cj.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "from-binary")
+    sj = cj.runtime.get_data_store("default").get_channel("text")
+    sj.insert_text(0, "json:")
+    assert wait_for(lambda: sb.get_text() == "json:from-binary"
+                    and sj.get_text() == "json:from-binary")
