@@ -214,10 +214,18 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def submit(self, messages) -> None:
         with self._t.lock:
             if self._binary:
-                self._t.send_body(binwire.encode_submit(messages))
-            else:
-                self._t.send({"t": "submit",
-                              "ops": [message_to_dict(m) for m in messages]})
+                try:
+                    body = binwire.encode_submit(messages)
+                except Exception:
+                    # a boxcar binwire cannot pack (>u16 ops, int outside
+                    # the fixed-field range) still goes through: the
+                    # server accepts both frame kinds on any connection
+                    body = None
+                if body is not None:
+                    self._t.send_body(body)
+                    return
+            self._t.send({"t": "submit",
+                          "ops": [message_to_dict(m) for m in messages]})
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})

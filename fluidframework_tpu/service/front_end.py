@@ -56,12 +56,7 @@ import threading
 from typing import Any, Optional
 
 from ..protocol import binwire
-from ..protocol.messages import (
-    DocumentMessage,
-    MessageType,
-    Nack,
-    NackErrorType,
-)
+from ..protocol.messages import Nack, NackErrorType
 from ..protocol.serialization import message_from_dict, message_to_dict
 from .local_server import LocalServer, ServerConnection
 
@@ -166,14 +161,23 @@ class _ClientSession:
         if self.binary:
             cached_key, raw = self.front._batch_cache_bin
             if cached_key != key:
-                raw = binwire.frame(binwire.encode_ops(batch))
+                try:
+                    raw = binwire.frame(binwire.encode_ops(batch))
+                except Exception:
+                    # a message binwire cannot pack (int outside the
+                    # fixed-field range, >u16 batch) must not break the
+                    # broadcast — binary clients dispatch JSON ops
+                    # frames too, so fall back per batch
+                    raw = None
                 self.front._batch_cache_bin = (key, raw)
-        else:
-            cached_key, raw = self.front._batch_cache
-            if cached_key != key:
-                raw = _encode_frame(
-                    {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
-                self.front._batch_cache = (key, raw)
+            if raw is not None:
+                self.push_raw(raw)
+                return
+        cached_key, raw = self.front._batch_cache
+        if cached_key != key:
+            raw = _encode_frame(
+                {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
+            self.front._batch_cache = (key, raw)
         self.push_raw(raw)
 
     def push_raw(self, raw: bytes) -> None:
@@ -219,24 +223,10 @@ class _ClientSession:
             elif t == "submit":
                 if self.conn is None:
                     raise RuntimeError("submit before connect")
-                ops, oversized = [], []
-                for d in frame["ops"]:
-                    op = message_from_dict(d)
-                    if len(json.dumps(d).encode()) > self.front.max_message_size:
-                        oversized.append(op)
-                    else:
-                        ops.append(op)
-                for op in oversized:
-                    # nack without entering the pipeline (ref 16KB limit,
-                    # localDeltaConnectionServer.ts:96)
-                    self.push("nack", {"nack": message_to_dict(Nack(
-                        operation=op,
-                        sequence_number=-1,
-                        code=413,
-                        type=NackErrorType.BAD_REQUEST,
-                        message=f"message exceeds {self.front.max_message_size}"
-                                " byte limit",
-                    ))})
+                # oversized ops nack without entering the pipeline (ref
+                # 16KB limit, localDeltaConnectionServer.ts:96)
+                ops = self._filter_oversized(
+                    [message_from_dict(d) for d in frame["ops"]], None, None)
                 if ops:
                     self.conn.submit(ops)
             elif t == "signal":
@@ -293,19 +283,21 @@ class _ClientSession:
                                     message=str(e))
             self.push("error", {"message": str(e)})
 
-    def _filter_oversized(self, ops: list, body_len: int, sid) -> list:
-        """Enforce the per-op service limit on binary boxcars.
+    def _filter_oversized(self, ops: list, body_len: Optional[int],
+                          sid) -> list:
+        """Enforce the per-op service limit; nack what exceeds it.
 
-        The limit is DEFINED as JSON size (the JSON door's measure, so
-        one op is admitted or nacked identically through either door).
-        Binwire is more compact than JSON — JSON escaping can double a
-        payload and the envelope keys add ~200 bytes — so the
-        skip-the-per-op-measurement fast path needs a conservative bound:
-        a whole boxcar body under (limit - 512) / 2 cannot contain an op
-        whose JSON measure exceeds the limit. Typical boxcars (KBs) pass
-        in one comparison; only outsized frames pay per-op JSON dumps."""
+        The limit is DEFINED as JSON size (so one op is admitted or
+        nacked identically through either door); JSON callers pass
+        ``body_len=None`` and every op is measured. Binary callers pass
+        the frame length for a fast path: binwire is more compact than
+        JSON — \\uXXXX escaping inflates a control/non-ASCII byte up to
+        6× and the envelope keys add ~200 bytes — so a whole boxcar body
+        under (limit - 512) / 6 cannot contain an op whose JSON measure
+        exceeds the limit. Typical boxcars (KBs) pass in one comparison;
+        only outsized frames pay per-op JSON dumps."""
         limit = self.front.max_message_size
-        if 2 * body_len + 512 <= limit:
+        if body_len is not None and 6 * body_len + 512 <= limit:
             return ops
         kept = []
         for op in ops:
@@ -360,10 +352,18 @@ class _ClientSession:
                         key = (topic, batch[0].sequence_number, len(batch))
                         ck, raw = self.front._fops_cache
                         if ck != key:
-                            raw = binwire.frame(
-                                binwire.encode_ops(batch, topic=topic))
+                            try:
+                                raw = binwire.frame(
+                                    binwire.encode_ops(batch, topic=topic))
+                            except Exception:
+                                raw = None  # unpackable: JSON fallback
                             self.front._fops_cache = (key, raw)
-                        self.push_raw(raw)
+                        if raw is not None:
+                            self.push_raw(raw)
+                        else:
+                            self.push("fops", {
+                                "topic": topic,
+                                "msgs": [message_to_dict(m) for m in batch]})
                 else:
                     def on_batch(batch, topic=topic):
                         self.push("fops", {
@@ -399,20 +399,10 @@ class _ClientSession:
             })
         elif t == "fsubmit":
             conn = self._fsessions[frame["sid"]]
-            ops = []
-            for d in frame["ops"]:
-                op = message_from_dict(d)
-                if len(json.dumps(d).encode()) > self.front.max_message_size:
-                    # same 16 KB service limit as the direct door
-                    self.push("fnack", {"sid": frame["sid"],
-                              "nack": message_to_dict(Nack(
-                                  operation=op, sequence_number=-1, code=413,
-                                  type=NackErrorType.BAD_REQUEST,
-                                  message=f"message exceeds "
-                                          f"{self.front.max_message_size}"
-                                          " byte limit"))})
-                else:
-                    ops.append(op)
+            # same 16 KB service limit as the direct door
+            ops = self._filter_oversized(
+                [message_from_dict(d) for d in frame["ops"]], None,
+                frame["sid"])
             if ops:
                 conn.submit(ops)
         elif t == "fsignal":

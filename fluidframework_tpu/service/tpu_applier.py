@@ -888,6 +888,12 @@ def save_applier_checkpoint(applier: "TpuDocumentApplier",
                         for k, v in applier._applied_seq.items()},
         "first_seq": {str(k): v for k, v in applier._first_seq.items()},
         "anchored": sorted(applier._anchored),
+        # a still-PENDING restart window must survive the save: without
+        # it, a save/load cycle would silently discharge an unverified
+        # window (load resets gap_lo to the current applied seq, hiding
+        # any downtime ops below it from the summarizer's gate)
+        "restore_applied": {str(k): v
+                            for k, v in applier._restore_applied.items()},
     }
     np.savez_compressed(path + ".npz", **arrays)
     with open(path + ".json", "w") as f:
@@ -934,4 +940,13 @@ def load_applier_checkpoint(path: str, **applier_kwargs
     # restored anchors are conditional: the summarizer additionally
     # verifies no ops were sequenced in the restart window (restore_gap)
     applier._restore_applied = dict(applier._applied_seq)
+    # compose with any window the CHECKPOINT itself left unverified:
+    # keep the older low bound, so the gate inspects the union
+    # (old_lo, new_hi) — conservative (may refuse ops the saved state
+    # actually covers, between the old resume point and the save), but
+    # never discharges a real hole
+    for k, v in meta.get("restore_applied", {}).items():
+        slot = int(k)
+        applier._restore_applied[slot] = min(
+            v, applier._restore_applied.get(slot, v))
     return applier

@@ -259,3 +259,28 @@ def test_json_and_binary_clients_interoperate(front_end):
     sj.insert_text(0, "json:")
     assert wait_for(lambda: sb.get_text() == "json:from-binary"
                     and sj.get_text() == "json:from-binary")
+
+
+def test_unpackable_message_falls_back_to_json_broadcast(front_end):
+    """An op binwire cannot pack (refSeq beyond the i32 fixed field) must
+    not break the broadcast: the front end falls back to a JSON ops
+    frame for that batch, which binary clients also dispatch."""
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port,
+                                            binary=True)
+    conn = factory.create_document_service(
+        "t", "odd").connect_to_delta_stream()
+    got = []
+    conn.on_op = got.append
+    big_ref = 2 ** 40  # valid per protocol (>= msn), outside binwire i32
+    conn.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=big_ref,
+        type=MessageType.OPERATION, contents={"free": "form"})])
+    assert wait_for(lambda: any(
+        m.client_id == conn.client_id
+        and m.reference_sequence_number == big_ref for m in got))
+    conn.close()

@@ -319,3 +319,54 @@ def test_summarize_refuses_restart_window_gap(tmp_path):
     c2 = loader.resolve("t", "doc")
     assert (c2.runtime.get_data_store("default").get_channel("text")
             .get_text() == "late downtime before ")
+
+
+def test_restart_window_survives_checkpoint_cycle(tmp_path):
+    """A save/load cycle must NOT discharge a pending (unverified)
+    restart window: checkpoint B saved while A's window is open keeps
+    A's low bound, so downtime ops still trip the summarizer gate."""
+    from fluidframework_tpu.service.tpu_applier import (
+        load_applier_checkpoint,
+        save_applier_checkpoint,
+    )
+
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "before ")
+
+    applier = TpuDocumentApplier(max_docs=4, max_slots=64,
+                                 ops_per_dispatch=8)
+    applier.set_replay_source(lambda t, d: [])
+    feed(applier, server, "t", "doc")
+    svc = ServiceSummarizer(server, applier)
+    svc.summarize_doc("t", "doc")  # anchors the slot
+    ck_a = str(tmp_path / "a")
+    save_applier_checkpoint(applier, ck_a)
+
+    # downtime ops → restore from A with an OPEN window, feed resumes late
+    s1.insert_text(0, "downtime ")
+    applier2 = load_applier_checkpoint(ck_a, ops_per_dispatch=8)
+    applier2.set_replay_source(lambda t, d: [])
+    s1.insert_text(0, "late ")
+    late_seq = max(m.sequence_number for m in channel_stream(
+        server, "t", "doc", "default", "text"))
+    for m in channel_stream(server, "t", "doc", "default", "text"):
+        if m.sequence_number >= late_seq:
+            applier2.ingest("t", "doc", m, m.contents)
+    # BEFORE any summarize (which would refuse), a routine save runs
+    ck_b = str(tmp_path / "b")
+    save_applier_checkpoint(applier2, ck_b)
+
+    applier3 = load_applier_checkpoint(ck_b, ops_per_dispatch=8)
+    applier3.set_replay_source(lambda t, d: [])
+    # feed resumes cleanly from B's applied seq — but A's window is
+    # still unverified and must still be enforced
+    ck_seq = applier3.applied_seq("t", "doc")
+    for m in channel_stream(server, "t", "doc", "default", "text"):
+        if m.sequence_number > ck_seq:
+            applier3.ingest("t", "doc", m, m.contents)
+    with pytest.raises(RuntimeError, match="restart window"):
+        ServiceSummarizer(server, applier3).summarize_doc("t", "doc")
