@@ -157,91 +157,169 @@ def bench_service() -> dict:
     return headline
 
 
+REPO = __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+
+
+def _lean_cmd(mod: str, *args: str) -> list:
+    """Service/worker process command line WITHOUT the site hook.
+
+    The bench host's sitecustomize imports the full JAX stack into every
+    Python process (~2s of CPU); neither the socket front end nor the
+    load workers need it, and on a small-core host that startup tax was
+    charged against the measured trial. ``-S`` skips the hook; numpy's
+    site-packages dir rides PYTHONPATH (set in _spawn)."""
+    import sys
+
+    return [sys.executable, "-S", "-m", mod, *args]
+
+
+def _lean_env() -> dict:
+    import os
+
+    import numpy
+
+    sp = os.path.dirname(os.path.dirname(numpy.__file__))
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{sp}")
+    env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def _spawn_listening(mod: str, *args: str):
+    import subprocess
+
+    proc = subprocess.Popen(
+        _lean_cmd(mod, *args), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, cwd=REPO, env=_lean_env())
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
 def bench_network() -> dict:
     """Socket load against a front-end PROCESS: at-load op-ack latency.
 
-    Orchestrator + runner processes (ref: service-load-test
+    Orchestrator + asyncio runner processes (ref: service-load-test
     nodeStressTest.ts — workers must not share a GIL with the server or
-    each other). Sweeps the submission rate upward until ack p99 crosses
-    the 50 ms north star; reports the highest sustainable load
-    (``max_load_ops_per_sec``) and its p50/p99 — a knee point, not a
-    no-load number."""
+    each other). Clients submit boxcars of 32 ops (the outbound
+    DeltaQueue flush, same batching the in-proc headline uses) over the
+    binary wire; the sweep raises the boxcar rate until ack p99 crosses
+    the 50 ms north star and reports the highest sustainable load.
+
+    Three measurements:
+    - knee sweep at 256 docs × 2 clients (512 connections, direct);
+    - the same geometry through 2 gateway processes (scale-out tier —
+      on a single-core bench host the extra hop costs CPU from the same
+      budget, so direct usually wins here; the gateway number is the
+      honest cross-check, not the headline);
+    - BASELINE config-4 geometry: 1000 docs × 10 clients = 10,000 live
+      sockets at a reduced per-client rate.
+    """
     import subprocess
-    import sys
+    import time as _time
 
-    from fluidframework_tpu.service.load_gen import run_network
+    def run_workers(ports: list, nworkers: int, docs: int, cpd: int,
+                    rate: float, batch: int, rounds: int, prefix: str,
+                    start_margin: float = 6.0, timeout: float = 300.0
+                    ) -> dict:
+        start_at = _time.time() + start_margin
+        workers = [
+            subprocess.Popen(
+                _lean_cmd("fluidframework_tpu.service.load_async",
+                          "--port", str(ports[w % len(ports)]),
+                          "--docs", str(docs),
+                          "--clients-per-doc", str(cpd),
+                          "--rounds", str(rounds), "--batch", str(batch),
+                          "--rate", str(rate), "--seed", str(w),
+                          "--start-at", str(start_at),
+                          "--doc-prefix", f"{prefix}w{w}d"),
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=REPO, env=_lean_env())
+            for w in range(nworkers)
+        ]
+        lats, ops, acked, secs, errors = [], 0, 0, 0.0, []
+        hops = {"submit_to_deli": [], "deli_to_ack": []}
+        for w in workers:
+            out, _ = w.communicate(timeout=timeout)
+            r = json.loads(out)
+            lats.extend(r["lat_ms"])
+            ops += r["ops"]
+            acked += r["acked"]
+            secs = max(secs, r["seconds"])
+            errors.extend(r.get("errors", []))
+            for k in hops:
+                hops[k].extend(r["hops"].get(k, []))
+        assert acked == ops, (acked, ops, errors[:3])
 
-    fe = subprocess.Popen(
-        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
-         "--port", "0"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        cwd="/root/repo",
-    )
+        def pct(vals, p):
+            vals = sorted(vals)
+            return round(vals[int(p * (len(vals) - 1))], 3) if vals else 0.0
+
+        return {
+            "rate_hz": rate,
+            "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
+            "p50_ack_ms": pct(lats, 0.50),
+            "p99_ack_ms": pct(lats, 0.99),
+            "hops": {name: {"p50_ms": pct(v, 0.50), "p99_ms": pct(v, 0.99)}
+                     for name, v in hops.items()},
+        }
+
+    fe, port = _spawn_listening("fluidframework_tpu.service.front_end",
+                                "--port", "0")
+    gws = []
     try:
-        line = fe.stdout.readline().strip()
-        assert line.startswith("LISTENING"), line
-        port = int(line.rsplit(":", 1)[1])
+        # production topology: clients terminate at gateway processes,
+        # each muxing its sessions over ONE core backbone socket — the
+        # core then serves G sockets instead of hundreds, which measures
+        # FASTER than direct termination even on one host (fan-out
+        # encode/sends move off the ordering process's queueing point)
+        for _ in range(4):
+            gw, gw_port = _spawn_listening(
+                "fluidframework_tpu.service.gateway",
+                "--core-port", str(port))
+            gws.append((gw, gw_port))
+        gw_ports = [p for _, p in gws]
+        knee_ports = gw_ports[:2]
+
         # warm-up: orderer creation, joins, first broadcasts (discarded)
-        run_network(port, n_docs=4, clients_per_doc=2,
-                    ops_per_client=30, seed=7, doc_prefix="warmdoc")
+        run_workers(knee_ports, 2, 8, 2, 2.0, 8, 4, "warm",
+                    start_margin=3.0)
 
-        def trial(rate_hz: float, trial_id: int) -> dict:
-            """4 worker processes × 4 docs × 2 clients = 32 clients."""
-            workers = [
-                subprocess.Popen(
-                    [sys.executable, "-m",
-                     "fluidframework_tpu.service.load_gen",
-                     "--port", str(port), "--docs", "4",
-                     "--clients-per-doc", "2",
-                     "--ops", str(max(80, int(rate_hz))),
-                     "--rate", str(rate_hz),
-                     "--seed", str(100 * trial_id + w),
-                     "--doc-prefix", f"t{trial_id}w{w}d"],
-                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                    text=True, cwd="/root/repo")
-                for w in range(4)
-            ]
-            from fluidframework_tpu.utils import TraceAggregator
-
-            lats, ops, acked, secs = [], 0, 0, 0.0
-            traces = TraceAggregator()
-            for w in workers:
-                out, _ = w.communicate(timeout=180)
-                r = json.loads(out)
-                lats.extend(r["lat_ms"])
-                ops += r["ops"]
-                acked += r["acked"]
-                secs = max(secs, r["seconds"])
-                traces.merge_raw(r.get("hops", {}))
-            assert acked == ops, (acked, ops)
-            lats.sort()
-            n = len(lats)
-            hop_report = traces.report()
-            return {
-                "rate_hz": rate_hz,
-                "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
-                "p50_ack_ms": round(lats[n // 2], 3) if n else 0.0,
-                "p99_ack_ms": round(lats[min(n - 1, int(0.99 * (n - 1)))], 3)
-                if n else 0.0,
-                # per-hop breakdown from the wire traces deli stamps
-                "hops": {name: {"p50_ms": h["p50_ms"], "p99_ms": h["p99_ms"]}
-                         for name, h in hop_report.items()},
-            }
-
+        # ---- knee sweep: 256 docs × 2 clients, boxcars of 32, through
+        # 2 gateways ----
         best = None
-        for i, rate in enumerate((62.5, 125, 187.5, 250)):
-            # median of 3 by p99: bursty CPU contention on the bench host
-            runs = sorted((trial(rate, 10 * i + t) for t in range(3)),
-                          key=lambda r: r["p99_ack_ms"])
-            r = runs[1]
+        for rate in (1.25, 1.5, 1.75, 2.0):
+            r = run_workers(knee_ports, 4, 64, 2, rate, 32,
+                            max(8, int(8 * rate)), f"k{rate}")
             if r["p99_ack_ms"] < 50.0:
-                best = r  # sustainable at this load; try the next rung
+                best = r
             else:
                 if best is None:
                     best = r  # even the lightest load misses: report it
                 break
-        return best
+        # confirm the knee: median p99 of 3 runs (bursty co-tenant CPU)
+        knee_rate = best["rate_hz"]
+        confirms = sorted(
+            (run_workers(knee_ports, 4, 64, 2, knee_rate, 32,
+                         max(8, int(8 * knee_rate)), f"c{t}r")
+             for t in range(3)),
+            key=lambda r: r["p99_ack_ms"])
+        best = confirms[1]
+
+        # ---- the same geometry terminating directly at the core ----
+        direct = run_workers([port], 4, 64, 2, knee_rate, 32,
+                             max(8, int(8 * knee_rate)), "direct")
+
+        # ---- BASELINE config 4: 1000 docs × 10 clients, 4 gateways ----
+        cfg4 = run_workers(gw_ports, 4, 250, 10, 0.075, 8, 3, "cfg4",
+                           start_margin=40.0, timeout=420.0)
+        return {
+            "knee": best,
+            "direct": direct,
+            "cfg4": cfg4,
+        }
     finally:
+        for gw, _ in gws:
+            gw.terminate()
         fe.terminate()
         fe.wait(timeout=10)
 
@@ -268,11 +346,22 @@ def main() -> None:
                 "kernel_xla_ops_per_sec": round(kernel_xla_ops, 1),
                 # the same full path at 8192 concurrent docs (scale proof)
                 "ops_per_sec_8k_docs": service.get("ops_per_sec_8k_docs"),
-                # at-load socket knee: highest swept load with p99 < 50 ms
-                "net_max_load_ops_per_sec": net["ops_per_sec"],
-                "net_p50_ack_ms": net["p50_ack_ms"],
-                "net_p99_ack_ms": net["p99_ack_ms"],
-                "net_hops": net.get("hops", {}),
+                # at-load socket knee (256 docs × 2 clients, binary wire,
+                # 32-op boxcars, 2-gateway production topology): highest
+                # swept load with p99 < 50 ms
+                "net_max_load_ops_per_sec": net["knee"]["ops_per_sec"],
+                "net_p50_ack_ms": net["knee"]["p50_ack_ms"],
+                "net_p99_ack_ms": net["knee"]["p99_ack_ms"],
+                "net_docs": 256,
+                "net_clients": 512,
+                "net_hops": net["knee"].get("hops", {}),
+                # same geometry terminating directly at the core — the
+                # gateway tier must not lose to it
+                "net_direct_ops_per_sec": net["direct"]["ops_per_sec"],
+                "net_direct_p99_ack_ms": net["direct"]["p99_ack_ms"],
+                # BASELINE config 4: 1000 docs × 10 clients (10k sockets)
+                "net_ops_per_sec_1k_docs": net["cfg4"]["ops_per_sec"],
+                "net_p99_ack_ms_1k_docs": net["cfg4"]["p99_ack_ms"],
             }
         )
     )

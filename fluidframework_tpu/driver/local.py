@@ -96,6 +96,9 @@ class LocalStorage(DocumentStorage):
             restore_version_records(server.log, server.db, tenant_id,
                                     document_id)
             restored.add((tenant_id, document_id))
+        self._server = server
+        self._tenant = tenant_id
+        self._doc = document_id
         self._db = server.db
         self._blobs = server.blob_store
         self._stats = server.storage_stats
@@ -164,11 +167,14 @@ class LocalStorage(DocumentStorage):
             tree_id = self.write_blob(json.dumps(summary).encode())
         n = len(self._db.collection(self._versions_col))
         version_id = f"v{n}"
-        self._db.upsert(
-            self._versions_col,
-            version_id,
-            {"n": n, "tree_id": tree_id, "parent": parent},
-        )
+        record = {"n": n, "tree_id": tree_id, "parent": parent}
+        self._db.upsert(self._versions_col, version_id, record)
+        hook = getattr(self._server, "on_version_uploaded", None)
+        if hook is not None:
+            # split-service composition: the external scribe process
+            # learns of uploads through this announcement (it has no
+            # view of this process's db)
+            hook(self._tenant, self._doc, version_id, record)
         return version_id
 
     def _version_root_ref(self, version_id: Optional[str]) -> Optional[dict]:

@@ -8,12 +8,20 @@ from .build import load_library
 
 
 class NativeOpLog:
-    """Durable append-only partitioned log of byte records."""
+    """Durable append-only partitioned log of byte records.
 
-    def __init__(self, directory: str):
+    ``readonly=True`` opens a CONSUMER handle for a directory another
+    process is writing: it never creates or truncates files, and
+    :meth:`refresh` tails records the producer has flushed
+    (``flush()``) since the last call — the cross-process pipe the
+    per-stage service composition rides (service/stage_runner.py)."""
+
+    def __init__(self, directory: str, readonly: bool = False):
         self._lib = load_library("oplog")
         self._lib.oplog_open.restype = ctypes.c_void_p
         self._lib.oplog_open.argtypes = [ctypes.c_char_p]
+        self._lib.oplog_open_readonly.restype = ctypes.c_void_p
+        self._lib.oplog_open_readonly.argtypes = [ctypes.c_char_p]
         self._lib.oplog_close.argtypes = [ctypes.c_void_p]
         self._lib.oplog_append.restype = ctypes.c_int64
         self._lib.oplog_append.argtypes = [
@@ -26,7 +34,14 @@ class NativeOpLog:
             ctypes.c_char_p, ctypes.c_int64]
         self._lib.oplog_sync.restype = ctypes.c_int
         self._lib.oplog_sync.argtypes = [ctypes.c_void_p]
-        self._handle = self._lib.oplog_open(directory.encode())
+        self._lib.oplog_flush.restype = ctypes.c_int
+        self._lib.oplog_flush.argtypes = [ctypes.c_void_p]
+        self._lib.oplog_refresh.restype = ctypes.c_int64
+        self._lib.oplog_refresh.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        self.readonly = readonly
+        opener = (self._lib.oplog_open_readonly if readonly
+                  else self._lib.oplog_open)
+        self._handle = opener(directory.encode())
         if not self._handle:
             raise OSError(f"cannot open op log at {directory}")
 
@@ -58,6 +73,18 @@ class NativeOpLog:
     def sync(self) -> None:
         if self._lib.oplog_sync(self._handle) != 0:
             raise OSError("sync failed")
+
+    def flush(self) -> None:
+        """Make buffered appends visible to consumer processes (fflush
+        into the page cache — durability still requires sync())."""
+        if self._lib.oplog_flush(self._handle) != 0:
+            raise OSError("flush failed")
+
+    def refresh(self, topic: str) -> int:
+        """Tail records another process appended; returns the topic's
+        refreshed length (0 if the producer hasn't created it yet)."""
+        n = self._lib.oplog_refresh(self._handle, topic.encode())
+        return 0 if n < 0 else n
 
     def close(self) -> None:
         if self._handle:

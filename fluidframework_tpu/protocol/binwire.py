@@ -306,26 +306,45 @@ def _read_payload(body: bytes, off: int, pool: list[str]) -> tuple:
     return _OP_TYPE, contents, None, None, off
 
 
-def decode_submit(body: bytes) -> tuple[Optional[int], list[DocumentMessage]]:
-    """Decode a submit/fsubmit body → (sid or None, ops)."""
+def decode_submit(body: bytes, with_spans: bool = False):
+    """Decode a submit/fsubmit body → (sid or None, ops).
+
+    With ``with_spans`` additionally returns a splice context the
+    broadcast encoder can reuse (see :func:`encode_ops_spliced`):
+    ``(sid, ops, spans_by_contents_id, pool_entries_blob, npool)`` —
+    spans are the raw payload bytes (kind byte included) keyed by
+    ``id(op.contents)``, valid while the decoded contents objects live."""
     ftype = body[1]
     if ftype == FT_FSUBMIT:
         (sid,) = _U32.unpack_from(body, 2)
         off = _FSUB_HDR.size
     else:
         sid, off = None, 2
+    pool_start = off + 2
     pool, off = _read_pool(body, off)
+    pool_blob = body[pool_start:off]
     (n,) = _U16.unpack_from(body, off)
     off += 2
     ops = []
+    spans: dict[int, bytes] = {}
     for _ in range(n):
         cseq, rseq = _DOC_FIXED.unpack_from(body, off)
         off += _DOC_FIXED.size
         traces, off = _read_traces(body, off, pool)
+        payload_start = off
         type_, contents, metadata, _, off = _read_payload(body, off, pool)
-        ops.append(DocumentMessage(
+        op = DocumentMessage(
             client_sequence_number=cseq, reference_sequence_number=rseq,
-            type=type_, contents=contents, metadata=metadata, traces=traces))
+            type=type_, contents=contents, metadata=metadata, traces=traces)
+        ops.append(op)
+        if with_spans and type(contents) is dict:
+            # identity-keyed: safe ONLY for dicts — json.loads returns a
+            # fresh dict per record (unique id while the ops are alive),
+            # whereas interned payloads (small ints, bools, str) would
+            # collide across records and splice the wrong bytes
+            spans[id(contents)] = body[payload_start:off]
+    if with_spans:
+        return sid, ops, spans, pool_blob, len(pool)
     return sid, ops
 
 
@@ -355,6 +374,129 @@ def decode_ops(body: bytes) -> tuple[Optional[str],
             type=type_, contents=contents, metadata=metadata, origin=origin,
             timestamp=ts, traces=traces))
     return topic, msgs
+
+
+def encode_ops_spliced(msgs: list[SequencedDocumentMessage],
+                       spans: dict[int, bytes], pool_blob: bytes,
+                       npool: int, *,
+                       topic: Optional[str] = None) -> Optional[bytes]:
+    """Encode a broadcast batch by SPLICING the submitted payload bytes.
+
+    The deli fast lane emits sequenced messages whose ``contents`` are
+    the very objects the submit decode produced, so the broadcast frame
+    can reuse the submit frame's payload bytes and string pool verbatim:
+    per op only the fixed header and trace hops are packed fresh, and
+    the payload — the bulk of the record — is a bytes copy. Returns
+    None when any message's contents is not from the splice context
+    (scalar-lane fallback, system messages): the caller then uses
+    :func:`encode_ops`.
+    """
+    extra = _Pool()
+    recs: list = [_U16.pack(len(msgs))]
+    try:
+        for m in msgs:
+            span = spans.get(id(m.contents))
+            if span is None or m.origin is not None:
+                return None
+            cid = m.client_id
+            recs.append(_SEQ_FIXED.pack(
+                _NONE_IDX if cid is None else npool + extra.add(cid),
+                m.sequence_number, m.minimum_sequence_number,
+                m.client_sequence_number, m.reference_sequence_number,
+                m.timestamp))
+            traces = m.traces
+            n = len(traces)
+            if n > 0xFF:
+                traces = traces[-0xFF:]
+                n = 0xFF
+            recs.append(bytes((n,)))
+            for t in traces:
+                recs.append(_TRACE.pack(npool + extra.add(t.service),
+                                        npool + extra.add(t.action),
+                                        t.timestamp))
+            recs.append(span)
+        total = npool + len(extra.items)
+        if total >= _NONE_IDX:
+            return None
+    except struct.error:
+        return None
+    if topic is None:
+        hdr = bytes((MAGIC, FT_OPS))
+    else:
+        tb = topic.encode()
+        hdr = bytes((MAGIC, FT_FOPS)) + _U16.pack(len(tb)) + tb
+    pool_out = [_U16.pack(total), pool_blob]
+    for b in extra.items:
+        pool_out.append(_U16.pack(len(b)))
+        pool_out.append(b)
+    return hdr + b"".join(pool_out) + b"".join(recs)
+
+
+def scan_ops(body: bytes):
+    """Lightweight walk of an ops/fops body for load observers.
+
+    Yields one tuple per record WITHOUT constructing message objects or
+    contents dicts — the load worker's broadcast observer only needs op
+    identity and the visible-length delta, and at the measured knee the
+    full decode (dataclass + 3 nested dicts per op, times every
+    subscriber) was the workers' largest CPU item:
+
+        (client_id | None, seq, cseq, deli_ts | None, delta)
+
+    ``delta`` is the op's visible-length change: +chars for an insert
+    (ASCII payloads: byte length == char length — the synthetic load
+    generator emits ASCII-only text), -span for a remove, 0 otherwise
+    (annotate/generic). ``deli_ts`` is the last deli/sequence trace hop
+    timestamp when the record carries one.
+    """
+    ftype = body[1]
+    if ftype == FT_FOPS:
+        (tl,) = _U16.unpack_from(body, 2)
+        off = 4 + tl
+    else:
+        off = 2
+    pool, off = _read_pool(body, off)
+    deli_idx = None
+    for i, s in enumerate(pool):
+        if s == "deli":
+            deli_idx = i
+            break
+    (n,) = _U16.unpack_from(body, off)
+    off += 2
+    for _ in range(n):
+        cid_idx, seq, msn, cseq, rseq, ts = _SEQ_FIXED.unpack_from(body, off)
+        off += _SEQ_FIXED.size
+        ntr = body[off]
+        off += 1
+        deli_ts = None
+        for _t in range(ntr):
+            svc, act, hop_ts = _TRACE.unpack_from(body, off)
+            off += _TRACE.size
+            if svc == deli_idx:
+                deli_ts = hop_ts
+        kind = body[off]
+        off += 1
+        delta = 0
+        if kind == 0:
+            off += _INS_HDR.size
+            (ln,) = _U16.unpack_from(body, off)
+            off += 2 + ln
+            delta = ln
+        elif kind == 1:
+            _, _, start, end = _SPAN.unpack_from(body, off)
+            off += _SPAN.size
+            delta = start - end
+        elif kind == 2:
+            off += _SPAN.size
+            (ln,) = _U16.unpack_from(body, off)
+            off += 2 + ln
+        elif kind == 0xFF:
+            (ln,) = _U32.unpack_from(body, off)
+            off += 4 + ln
+        else:
+            raise ValueError(f"unknown binwire payload kind {kind}")
+        yield (None if cid_idx == _NONE_IDX else pool[cid_idx],
+               seq, cseq, deli_ts, delta)
 
 
 # --------------------------------------------------- gateway byte rewrites

@@ -62,15 +62,87 @@ def _decode_value(data: bytes) -> Any:
 
 
 def _sanitize(topic: str) -> str:
-    return topic.replace("/", ".")
+    """Bijective topic → file-name mapping (oplog topic names allow only
+    [alnum._-]). '/' becomes '.'; a literal '.' in a tenant/doc id is
+    escaped first so _desanitize can invert exactly — without the escape,
+    a doc named 'notes.v2' would round-trip through list_topics as
+    'notes/v2' and stage backchannel records would route to a
+    nonexistent doc."""
+    return topic.replace("_", "__").replace(".", "_d").replace("/", ".")
+
+
+def _desanitize(name: str) -> str:
+    out = []
+    i, n = 0, len(name)
+    while i < n:
+        c = name[i]
+        if c == ".":
+            out.append("/")
+        elif c == "_" and i + 1 < n:
+            nxt = name[i + 1]
+            if nxt == "_":
+                out.append("_")
+                i += 1
+            elif nxt == "d":
+                out.append(".")
+                i += 1
+            else:
+                out.append(c)
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
 
 
 class DurableLog(OrderedLogBase):
-    """Persistent ordered topics with subscriber fan-out."""
+    """Persistent ordered topics with subscriber fan-out.
 
-    def __init__(self, directory: str):
+    ``readonly=True`` opens a CONSUMER-PROCESS view over a directory
+    another process writes (the Kafka consumer-group role): appends are
+    refused by the native layer, and :meth:`poll` tails newly flushed
+    producer records into this process's subscribers. A producer makes
+    its appends visible with :meth:`flush` (page cache, cheap) and
+    durable with :meth:`sync` (fsync, checkpoint boundaries)."""
+
+    def __init__(self, directory: str, readonly: bool = False):
         super().__init__()
-        self._log = NativeOpLog(directory)
+        self.directory = directory
+        self._log = NativeOpLog(directory, readonly=readonly)
+
+    def poll(self) -> bool:
+        """Refresh every subscribed topic from disk; mark grown topics
+        dirty. Returns True when drain() has new work."""
+        grew = False
+        for topic in self._order:
+            n = self._log.refresh(_sanitize(topic))
+            if any(pos[0] < n for _, pos in self._subs.get(topic, ())):
+                self._dirty[topic] = None
+                grew = True
+        return grew
+
+    def list_topics(self, prefix: str = "") -> list[str]:
+        """Topics present on disk (desanitized), optionally filtered by
+        prefix — how a consumer process discovers per-doc topics."""
+        import os
+
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if name.endswith(".idx"):
+                topic = _desanitize(name[:-4])
+                if topic.startswith(prefix):
+                    out.append(topic)
+        return sorted(out)
+
+    def refresh_topic(self, topic: str) -> int:
+        """Refresh ONE topic from disk; returns its record count."""
+        return self._log.refresh(_sanitize(topic))
+
+    def flush(self) -> None:
+        self._log.flush()
 
     def _store(self, topic: str, value: Any) -> int:
         return self._log.append(_sanitize(topic), _encode_value(value))

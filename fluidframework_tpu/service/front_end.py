@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import functools
 import json
 import threading
 from typing import Any, Optional
@@ -113,8 +114,10 @@ class _ClientSession:
     # runs on the loop thread) --
     # a session whose unread outbound buffer passes this bound is dropped
     # (slow-consumer protection — fan-out writes are not awaited, so an
-    # unread socket would otherwise buffer the doc's whole stream in RAM)
-    @property
+    # unread socket would otherwise buffer the doc's whole stream in RAM).
+    # Snapshotted once per session: the chained config lookup was a
+    # measurable cost at two checks per broadcast push.
+    @functools.cached_property
     def MAX_BUFFERED(self) -> int:
         return self.front.server.config.max_buffered_bytes
 
@@ -162,7 +165,13 @@ class _ClientSession:
             cached_key, raw = self.front._batch_cache_bin
             if cached_key != key:
                 try:
-                    raw = binwire.frame(binwire.encode_ops(batch))
+                    body = None
+                    ctx = self.front._splice_ctx
+                    if ctx is not None:
+                        body = binwire.encode_ops_spliced(batch, *ctx)
+                    if body is None:
+                        body = binwire.encode_ops(batch)
+                    raw = binwire.frame(body)
                 except Exception:
                     # a message binwire cannot pack (int outside the
                     # fixed-field range, >u16 batch) must not break the
@@ -266,16 +275,29 @@ class _ClientSession:
             if ftype == binwire.FT_SUBMIT:
                 if self.conn is None:
                     raise RuntimeError("submit before connect")
-                _, ops = binwire.decode_submit(body)
+                _, ops, spans, blob, npool = binwire.decode_submit(
+                    body, with_spans=True)
                 ops = self._filter_oversized(ops, len(body), None)
                 if ops:
-                    self.conn.submit(ops)
+                    # expose the splice context for the SYNCHRONOUS
+                    # broadcast this submit triggers: the encoder reuses
+                    # the submitted payload bytes instead of re-packing
+                    self.front._splice_ctx = (spans, blob, npool)
+                    try:
+                        self.conn.submit(ops)
+                    finally:
+                        self.front._splice_ctx = None
             elif ftype == binwire.FT_FSUBMIT:
-                sid, ops = binwire.decode_submit(body)
+                sid, ops, spans, blob, npool = binwire.decode_submit(
+                    body, with_spans=True)
                 conn = self._fsessions[sid]
                 ops = self._filter_oversized(ops, len(body), sid)
                 if ops:
-                    conn.submit(ops)
+                    self.front._splice_ctx = (spans, blob, npool)
+                    try:
+                        conn.submit(ops)
+                    finally:
+                        self.front._splice_ctx = None
             else:
                 raise ValueError(f"unexpected binary frame type {ftype}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
@@ -353,8 +375,15 @@ class _ClientSession:
                         ck, raw = self.front._fops_cache
                         if ck != key:
                             try:
-                                raw = binwire.frame(
-                                    binwire.encode_ops(batch, topic=topic))
+                                body = None
+                                ctx = self.front._splice_ctx
+                                if ctx is not None:
+                                    body = binwire.encode_ops_spliced(
+                                        batch, *ctx, topic=topic)
+                                if body is None:
+                                    body = binwire.encode_ops(batch,
+                                                              topic=topic)
+                                raw = binwire.frame(body)
                             except Exception:
                                 raw = None  # unpackable: JSON fallback
                             self.front._fops_cache = (key, raw)
@@ -505,6 +534,16 @@ class NetworkFrontEnd:
         self._batch_cache: tuple = (None, b"")
         self._batch_cache_bin: tuple = (None, b"")
         self._fops_cache: tuple = (None, b"")
+        # splice context of the binary submit currently on the stack
+        # (handle_binary sets it around conn.submit)
+        self._splice_ctx: Optional[tuple] = None
+        # split-service composition (stage_runner.py): stage backchannel
+        # logs this core consumes, and whether the shared log needs
+        # visibility flushes for external consumers
+        self._backchannels: list = []
+        self._log_flush = hasattr(self.server.log, "flush")
+        # (tenant, doc) → applied seq reported by an applier stage
+        self.applier_status: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
@@ -528,6 +567,11 @@ class NetworkFrontEnd:
                     session.handle_binary(body)
                 else:
                     session.handle(json.loads(body.decode()))
+                if self._log_flush:
+                    # make this frame's appends visible to the stage
+                    # processes tailing the shared log (dirty-topic-only
+                    # fflush — cheap)
+                    self.server.log.flush()
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass  # malformed stream: drop the connection
@@ -538,10 +582,55 @@ class NetworkFrontEnd:
             except Exception:
                 pass
 
+    def attach_backchannel(self, state_dir: str) -> None:
+        """Consume a stage process's backchannel log (stage_runner.py):
+        summary ack/nack raw messages are ordered into the stream,
+        version commits land through the orderer's ref path, retention
+        advances truncate, applier status is recorded."""
+        from .durable_log import DurableLog
+        from .stage_runner import BACKCHANNEL_TOPIC
+
+        bc = DurableLog(state_dir, readonly=True)
+        bc.subscribe(BACKCHANNEL_TOPIC, self._on_backchannel_record)
+        self._backchannels.append(bc)
+
+    def _on_backchannel_record(self, message) -> None:
+        rec = message.value
+        kind = rec.get("kind")
+        tenant, doc = rec["tenant"], rec["doc"]
+        orderer = self.server._get_orderer(tenant, doc)
+        if kind == "raw":
+            orderer.order(rec["raw"])
+            self.server._maybe_drain()
+        elif kind == "version":
+            orderer.commit_external_version(rec["handle"], rec["version"])
+        elif kind == "retention":
+            orderer.apply_retention(rec["capture_seq"])
+        elif kind == "applied":
+            self.applier_status[(tenant, doc)] = rec["applied_seq"]
+
+    async def _poll_backchannels(self) -> None:
+        while True:
+            moved = False
+            for bc in self._backchannels:
+                if bc.poll():
+                    bc.drain()
+                    moved = True
+            if moved and self._log_flush:
+                # acks ordered above must become visible to the stages
+                self.server.log.flush()
+            await asyncio.sleep(0.002)
+
     async def _start(self) -> None:
+        # deep backlog: load tests open hundreds of connections at once,
+        # and an overflowing accept queue turns into 1-3 s SYN
+        # retransmission outliers in the latency measurement
         self._aio_server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port)
+            self._handle_conn, self.host, self.port, backlog=1024)
         self.port = self._aio_server.sockets[0].getsockname()[1]
+        if self._backchannels:
+            asyncio.get_running_loop().create_task(
+                self._poll_backchannels())
         self._ready.set()
 
     def start_background(self) -> "NetworkFrontEnd":
@@ -576,10 +665,28 @@ class NetworkFrontEnd:
             self._loop = None
 
     def serve_forever(self) -> None:
+        import gc
+
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
         loop.run_until_complete(self._start())
+        if not gc.isenabled():
+            # the host disabled the cycle collector (see main()): sweep
+            # accumulated cycles on a coarse timer instead
+            def _sweep():
+                gc.collect()
+                loop.call_later(30.0, _sweep)
+            loop.call_later(30.0, _sweep)
+        if self._log_flush:
+            # durable-log deployment: periodic pipeline checkpoints so a
+            # killed core resumes from them (deli/scribe offsets +
+            # scriptorium retention base ride the checkpoint topic)
+            def _checkpoint():
+                self.server.checkpoint_all()
+                self.server.log.flush()
+                loop.call_later(2.0, _checkpoint)
+            loop.call_later(2.0, _checkpoint)
         # readiness marker for process supervisors / tests
         print(f"LISTENING {self.host}:{self.port}", flush=True)
         loop.run_forever()
@@ -595,8 +702,23 @@ def main() -> None:
     parser.add_argument("--tenant", action="append", default=[],
                         metavar="ID:SECRET",
                         help="register a tenant (token auth enforced)")
+    # split-service composition (stage_runner.py): the core owns the
+    # durable log + sockets + deli/scriptorium/broadcaster; scribe and
+    # the applier run as separate OS processes over the same log
+    parser.add_argument("--log-dir", default=None,
+                        help="durable C++ op log directory (this process "
+                             "is its single writer)")
+    parser.add_argument("--storage-dir", default=None,
+                        help="native chunk-store directory for blobs")
+    parser.add_argument("--external-scribe", action="store_true",
+                        help="scribe runs out of process; summary "
+                             "uploads are announced on the log")
+    parser.add_argument("--consume-backchannel", action="append",
+                        default=[], metavar="STATE_DIR",
+                        help="a stage process's state dir to consume")
     args = parser.parse_args()
     server = None
+    tenants = None
     if args.tenant:
         from .tenants import TenantManager
 
@@ -604,14 +726,36 @@ def main() -> None:
         for spec in args.tenant:
             tid, _, secret = spec.partition(":")
             tenants.register(tid, secret)
-        server = LocalServer(tenants=tenants)
-    # steady-state GC posture for a long-lived service process: mid-drain
-    # gen2 collections scanning the scriptorium logs are the largest
-    # latency-spike source under load
-    gc.set_threshold(200000, 50, 50)
+    if args.tenant or args.log_dir or args.storage_dir \
+            or args.external_scribe:
+        log = None
+        if args.log_dir:
+            from .durable_log import DurableLog
+
+            log = DurableLog(args.log_dir)
+        server = LocalServer(tenants=tenants, log=log,
+                             storage_dir=args.storage_dir,
+                             external_scribe=args.external_scribe)
+        if args.external_scribe:
+            def announce_upload(tenant, doc, vid, rec, server=server):
+                server.log.append(f"uploads/{tenant}/{doc}",
+                                  {"version_id": vid, "record": rec})
+                server.log.flush()
+            server.on_version_uploaded = announce_upload
+    # GC posture for a long-lived service process: the op path allocates
+    # acyclic object graphs only (messages, dicts, frames), so the cycle
+    # collector buys nothing on the hot path — mid-drain collections
+    # scanning the scriptorium logs were the largest latency-spike
+    # source under load. Disable it and sweep cycles (asyncio exception
+    # tracebacks etc.) on a coarse idle timer instead.
     gc.freeze()
-    NetworkFrontEnd(server=server, host=args.host, port=args.port,
-                    max_message_size=args.max_message_size).serve_forever()
+    gc.disable()
+
+    front = NetworkFrontEnd(server=server, host=args.host, port=args.port,
+                            max_message_size=args.max_message_size)
+    for state_dir in args.consume_backchannel:
+        front.attach_backchannel(state_dir)
+    front.serve_forever()
 
 
 if __name__ == "__main__":

@@ -290,7 +290,7 @@ class Gateway:
     async def _start(self) -> None:
         await self._connect_upstream()
         server = await asyncio.start_server(
-            self._handle_client, self.host, self.port)
+            self._handle_client, self.host, self.port, backlog=1024)
         self.port = server.sockets[0].getsockname()[1]
 
     def serve_forever(self) -> None:
@@ -310,8 +310,10 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     args = p.parse_args()
-    gc.set_threshold(200000, 50, 50)
+    # relay path allocates acyclic graphs only; cycle-collector pauses
+    # would land directly on forwarded-frame latency (see front_end main)
     gc.freeze()
+    gc.disable()
     Gateway(args.core_host, args.core_port,
             host=args.host, port=args.port).serve_forever()
 

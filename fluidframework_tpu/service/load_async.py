@@ -1,0 +1,258 @@
+"""Asyncio load worker: thousands of socket clients per process.
+
+Ref: packages/test/service-load-test/src/nodeStressTest.ts — the
+reference's orchestrator spawns runner processes, each hosting many
+socket.io clients on one Node event loop. The thread-per-connection
+driver stack (driver/network.py) is the right shape for a real client
+app but caps a load WORKER at a few hundred connections; this worker
+hosts each client as an asyncio task on one loop, which is what makes
+the BASELINE config-4 geometry (1k docs × 10 clients = 10k sockets)
+drivable from a handful of processes.
+
+Clients speak the production wire protocol (front_end.py): JSON connect
+handshake, binwire submit boxcars, binwire ops broadcasts. Each client
+submits ``rounds`` boxcars of ``batch`` ops paced at ``rate_hz`` rounds
+per second (absolute schedule, so pacing error does not accumulate), and
+samples op-ack latency once per boxcar (submit → own last-op broadcast).
+
+One JSON result line on stdout, same shape as load_gen's thread worker:
+``{"ops", "acked", "seconds", "lat_ms", "hops"}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import socket as _socket
+import time
+from typing import Optional
+
+from ..protocol import binwire
+from ..protocol.messages import TraceHop
+from .synthetic import SyntheticEditor
+
+
+class _AsyncClient:
+    """One synthetic client: connection + editor + pacing schedule."""
+
+    def __init__(self, host: str, port: int, tenant: str, doc: str,
+                 rng: random.Random, batch: int, rounds: int):
+        self.host, self.port = host, port
+        self.tenant, self.doc = tenant, doc
+        self.editor = SyntheticEditor(rng)
+        self.batch = batch
+        self.rounds = rounds
+        # random phase spreads the fleet across the round period —
+        # without it every client submits at the same instant and the
+        # measurement becomes burst queueing, not steady-state load
+        self.phase = rng.random()
+        self.client_id: Optional[str] = None
+        # boxcar-last cseq → (perf t0, wall t0)
+        self.pending: dict[int, tuple] = {}
+        self.lat_ms: list[float] = []
+        self.acked = 0
+        self.submitted = 0
+        # per-hop splits computed locally from the record's deli stamp
+        self.hops: dict[str, list] = {"submit_to_deli": [],
+                                      "deli_to_ack": []}
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.error: Optional[str] = None
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+        sock = self.writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        body = json.dumps({"t": "connect", "tenant": self.tenant,
+                           "doc": self.doc, "bin": 1, "rid": 1},
+                          separators=(",", ":")).encode()
+        self.writer.write(len(body).to_bytes(4, "big") + body)
+        await self.writer.drain()
+        # the connected reply may be preceded by pushed frames
+        while self.client_id is None:
+            frame = await self._read()
+            if frame is None:
+                raise ConnectionError("closed during handshake")
+
+    async def _read(self):
+        """Read one frame; dispatch pushes; return JSON reply dicts."""
+        header = await self.reader.readexactly(4)
+        body = await self.reader.readexactly(int.from_bytes(header, "big"))
+        if binwire.is_binary(body):
+            self._observe(body)
+            return {}
+        frame = json.loads(body.decode())
+        if frame.get("t") == "connected":
+            self.client_id = frame["clientId"]
+        elif frame.get("t") == "error":
+            raise RuntimeError(frame.get("message"))
+        return frame
+
+    def _observe(self, body: bytes) -> None:
+        """Track a broadcast via the lazy scan — no message objects.
+
+        The editor only needs its visible-length lower bound and the
+        latest ref seq; full decode of every subscriber's copy was the
+        workers' largest CPU item at the knee."""
+        me = self.client_id
+        ed = self.editor
+        for cid, seq, cseq, deli_ts, delta in binwire.scan_ops(body):
+            ed.ref_seq = seq
+            if cid is None or me is None:
+                continue
+            if cid == me:
+                self.acked += 1
+                t0 = self.pending.pop(cseq, None)
+                if t0 is not None:
+                    now = time.perf_counter()
+                    self.lat_ms.append((now - t0[0]) * 1e3)
+                    if deli_ts is not None:
+                        wall = time.time()
+                        self.hops["submit_to_deli"].append(
+                            (deli_ts - t0[1]) * 1e3)
+                        self.hops["deli_to_ack"].append(
+                            (wall - deli_ts) * 1e3)
+            elif delta > 0:
+                ed.length += delta
+            elif delta < 0:
+                ed.length = max(0, ed.length + delta)
+
+    async def read_loop(self) -> None:
+        try:
+            while True:
+                await self._read()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            pass
+        except Exception as e:  # server error frame etc. — a silently
+            # dead reader would surface only as a missing-acks timeout
+            # with the actual cause lost (stderr is discarded)
+            self.error = f"{type(e).__name__}: {e}"
+
+    async def run_rounds(self, t0: float, rate_hz: float) -> None:
+        for i in range(self.rounds):
+            target = t0 + (i + self.phase) / rate_hz
+            delay = target - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            ops = self.editor.next_ops(self.batch)
+            # latency is timed per boxcar on its last op. That op also
+            # carries a client trace stamp: deli's SAMPLED tracing only
+            # stamps pre-traced ops (deli.py fast lane), and the stamp is
+            # what brings the deli timestamp back for the hop split
+            # (submit→deli, deli→ack) computed locally on ack
+            ops[-1].traces.append(TraceHop(
+                service="client", action="submit", timestamp=time.time()))
+            self.pending[ops[-1].client_sequence_number] = (
+                time.perf_counter(), time.time())
+            self.writer.write(binwire.frame(binwire.encode_submit(ops)))
+            self.submitted += len(ops)
+            await self.writer.drain()
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
+                   rounds: int, batch: int, rate_hz: float, seed: int,
+                   doc_prefix: str, tenant: str = "bench",
+                   connect_concurrency: int = 64,
+                   timeout: float = 120.0,
+                   start_at: Optional[float] = None) -> dict:
+    rng = random.Random(seed)
+    clients = [
+        _AsyncClient(host, port, tenant, f"{doc_prefix}{d}",
+                     random.Random(rng.random()), batch, rounds)
+        for d in range(n_docs) for _ in range(clients_per_doc)
+    ]
+    # staged connects: a 10k-connection stampede overruns the listen
+    # backlog and makes join storms the measurement instead of steady load
+    sem = asyncio.Semaphore(connect_concurrency)
+
+    async def staged_connect(c):
+        async with sem:
+            await c.connect()
+
+    await asyncio.gather(*(staged_connect(c) for c in clients))
+    readers = [asyncio.ensure_future(c.read_loop()) for c in clients]
+
+    if start_at is not None:
+        # cross-worker synchronized start: the orchestrator hands every
+        # worker the same wall-clock instant so no worker's trial runs
+        # against another worker's connect storm
+        delay = start_at - time.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+    t0 = time.perf_counter()
+    await asyncio.gather(*(c.run_rounds(t0, rate_hz) for c in clients))
+    expected = sum(c.submitted for c in clients)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if sum(c.acked for c in clients) >= expected:
+            break
+        await asyncio.sleep(0.01)
+    seconds = time.perf_counter() - t0
+
+    lat = []
+    hops: dict[str, list] = {"submit_to_deli": [], "deli_to_ack": []}
+    for c in clients:
+        lat.extend(c.lat_ms)
+        for name, vals in c.hops.items():
+            hops[name].extend(vals)
+    for r in readers:
+        r.cancel()
+    for c in clients:
+        c.close()
+    return {
+        "ops": expected,
+        "acked": sum(c.acked for c in clients),
+        "seconds": seconds,
+        "lat_ms": lat,
+        "hops": hops,
+        "errors": [c.error for c in clients if c.error],
+    }
+
+
+def main() -> None:
+    import argparse
+    import gc
+    import sys
+
+    p = argparse.ArgumentParser(description="asyncio socket load worker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--docs", type=int, default=32)
+    p.add_argument("--clients-per-doc", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="boxcar rounds per second per client")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--doc-prefix", default="netdoc")
+    p.add_argument("--start-at", type=float, default=None,
+                   help="wall-clock epoch at which to start submitting")
+    args = p.parse_args()
+
+    # the worker's op path allocates acyclic graphs only; the cycle
+    # collector's periodic scans would show up directly as ack-latency
+    # spikes in the measurement (the process is short-lived — leaked
+    # cycles die with it)
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    result = asyncio.run(run_load(
+        args.host, args.port, args.docs, args.clients_per_doc,
+        args.rounds, args.batch, args.rate, args.seed, args.doc_prefix,
+        start_at=args.start_at))
+    json.dump(result, sys.stdout)
+    print()
+
+
+if __name__ == "__main__":
+    main()

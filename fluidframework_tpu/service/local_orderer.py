@@ -82,6 +82,7 @@ class LocalOrderer:
         client_timeout: Optional[float] = None,
         logger=None,
         log_retention_ops: Optional[int] = None,
+        external_scribe: bool = False,
     ):
         self.tenant_id = tenant_id
         self.document_id = document_id
@@ -125,19 +126,20 @@ class LocalOrderer:
         scribe_cp = db.find_one(
             SCRIBE_CHECKPOINT_COLLECTION, f"{tenant_id}/{document_id}")
         scribe_state = scribe_log_cp or (scribe_cp["state"] if scribe_cp else None)
-        on_committed = None
-        if log_retention_ops is not None and log_retention_ops >= 0:
-            retention = log_retention_ops
+        self._retention_margin = (
+            log_retention_ops
+            if log_retention_ops is not None and log_retention_ops >= 0
+            else None)
+        on_committed = (self.apply_retention
+                        if self._retention_margin is not None else None)
 
-            def on_committed(capture_seq: int) -> None:
-                # ops the acked summary covers truncate, minus a margin
-                # for in-flight backfills (config.log_retention_ops)
-                self.scriptorium.truncate_below(
-                    tenant_id, document_id, capture_seq - retention)
-        def persist_version(handle: str, version: dict) -> None:
-            log.append(_versions_topic(tenant_id, document_id),
-                       {"handle": handle, "version": dict(version)})
-
+        # With an EXTERNAL scribe (per-stage process composition,
+        # service/stage_runner.py), validation/acking happens in the
+        # scribe process; this in-core instance is retained ONLY as the
+        # ref-committer (commit_version is the single ref-update path)
+        # driven by backchannel records — it is not subscribed to the
+        # deltas topic, so its protocol replica stays untouched.
+        self.external_scribe = external_scribe
         self.scribe = ScribeLambda(
             tenant_id,
             document_id,
@@ -145,7 +147,7 @@ class LocalOrderer:
             send_to_deli=self.order,
             checkpoint=scribe_state,
             on_summary_committed=on_committed,
-            persist_version=persist_version,
+            persist_version=self.persist_version_record,
         )
         restore_version_records(log, db, tenant_id, document_id)
 
@@ -159,9 +161,11 @@ class LocalOrderer:
         self._subscriptions = [
             (self.raw_topic, self.deli.handler, 0),
             (self.deltas_topic, self.scriptorium.handler, 0),
-            (self.deltas_topic, self.scribe.handler, 0),
             (self.deltas_topic, self.broadcaster.handler, log.length(self.deltas_topic)),
         ]
+        if not external_scribe:
+            self._subscriptions.insert(
+                2, (self.deltas_topic, self.scribe.handler, 0))
         for topic, handler, from_offset in self._subscriptions:
             self._log.subscribe(topic, handler, from_offset=from_offset)
         # re-apply the persisted retention AFTER the deltas-topic replay
@@ -174,6 +178,36 @@ class LocalOrderer:
     # single RawMessage or a RawBoxcar (one log record either way)
     def order(self, raw) -> None:
         self._log.append(self.raw_topic, raw)
+
+    def persist_version_record(self, handle: str, version: dict) -> None:
+        """Append an acked version record to the durable versions topic —
+        the scribe-ref commit path (in-core scribe AND the external
+        scribe's backchannel both land here)."""
+        self._log.append(_versions_topic(self.tenant_id, self.document_id),
+                         {"handle": handle, "version": dict(version)})
+
+    def apply_retention(self, capture_seq: int) -> None:
+        """Truncate ops an acked summary covers, minus the in-flight
+        backfill margin (config.log_retention_ops)."""
+        if self._retention_margin is None:
+            return
+        self.scriptorium.truncate_below(
+            self.tenant_id, self.document_id,
+            capture_seq - self._retention_margin)
+
+    def commit_external_version(self, handle: str, version: dict) -> None:
+        """Apply an external scribe's version commit (stage_runner
+        backchannel): the stage validated and acked; this process owns
+        the db + versions topic + head."""
+        from .core import summary_versions_collection
+
+        col = summary_versions_collection(self.tenant_id, self.document_id)
+        existing = self._db.find_one(col, handle)
+        already_acked = bool(existing and existing.get("acked"))
+        self._db.upsert(col, handle, dict(version))
+        self.scribe.last_summary_head = handle
+        if not already_acked:
+            self.persist_version_record(handle, version)
 
     def close(self) -> None:
         """Detach from the log (partition shutdown); a successor orderer

@@ -133,6 +133,7 @@ class LocalServer:
         logger=None,
         config=None,
         tenants=None,
+        external_scribe: bool = False,
     ):
         from ..config import DEFAULT
         from ..utils import TelemetryLogger
@@ -164,6 +165,12 @@ class LocalServer:
         self.storage_stats = {"handles_reused": 0, "trees_written": 0,
                               "blobs_written": 0}
         self._orderers: dict[str, LocalOrderer] = {}
+        # per-stage process composition (stage_runner.py): scribe runs in
+        # its own OS process; uploads are announced to it via the hook
+        self.external_scribe = external_scribe
+        # fired as (tenant, doc, version_id, record) after a summary
+        # upload lands in the versions collection
+        self.on_version_uploaded = None
         self._auto_drain = auto_drain
         self._clock = clock
         self._client_timeout = client_timeout
@@ -285,6 +292,7 @@ class LocalServer:
                 tenant_id, document_id, self.log, self.db, self.pubsub,
                 clock=self._clock, logger=self.logger,
                 log_retention_ops=retention if retention >= 0 else None,
+                external_scribe=self.external_scribe,
                 **kw)
         return self._orderers[key]
 

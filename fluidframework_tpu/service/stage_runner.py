@@ -1,0 +1,279 @@
+"""Per-stage service processes over the shared durable log.
+
+Ref: the reference deploys each pipeline lambda as its own service
+process connected only by the Kafka log — alfred/deli/scribe/…
+each have a www.ts entrypoint run by the kafka-service runner
+(server/routerlicious/packages/routerlicious/src/*/www.ts,
+lambdas-driver/src/kafka-service/runner.ts:13, docker-compose.yml).
+
+Here the shared medium is the native C++ op log (native/oplog.cpp): the
+CORE process (front_end.py with ``--log-dir``) is the single writer of
+the rawops/deltas topics and flushes appends into the page cache; each
+stage process opens the same directory READ-ONLY and tails it
+(DurableLog.poll). Stage → core communication rides the stage's own
+writable log directory (its "backchannel"), which the core polls — every
+topic keeps exactly one writer, so no cross-process file locking exists
+anywhere.
+
+Stages:
+
+- ``scribe``  — the summary validator/acker (ScribeLambda) out of
+  process. Consumes deltas + upload announcements; emits summary
+  ack/nack raw messages, version commits, and retention advances on the
+  backchannel. Checkpoints its protocol replica + offsets to its own
+  log; kill -9 and restart resumes from the checkpoint (deltas replay is
+  idempotent by sequence number).
+- ``applier`` — the TPU device farm (TpuDocumentApplier) out of
+  process: the deli/broadcast hot path never shares a GIL with device
+  work. Consumes deltas chanops, checkpoints the device farm
+  (save_applier_checkpoint) periodically, and reports per-doc applied
+  seqs on its backchannel as status records.
+
+Deployment:
+
+    python -m fluidframework_tpu.service.stage_runner \
+        --stage scribe --log-dir LOG --state-dir STATE
+
+The core consumes STATE with ``front_end --consume-backchannel STATE``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import time
+from typing import Optional
+
+from ..protocol.messages import MessageType
+from .core import InMemoryDb, summary_versions_collection
+from .durable_log import DurableLog
+
+BACKCHANNEL_TOPIC = "backchannel"
+POLL_INTERVAL_S = 0.002
+
+
+def _doc_of(topic: str) -> tuple[str, str]:
+    _, tenant, doc = topic.split("/", 2)
+    return tenant, doc
+
+
+class _StageHostBase:
+    """Discovery + poll/drain/checkpoint loop shared by the stages."""
+
+    #: deltas topics are the stage input; uploads only matter to scribe
+    topic_prefixes = ("deltas/",)
+
+    def __init__(self, log_dir: str, state_dir: str):
+        self.shared = DurableLog(log_dir, readonly=True)
+        self.state = DurableLog(state_dir)
+        self._known: set[str] = set()
+        self._last_checkpoint = time.monotonic()
+        self.checkpoint_every_s = 1.0
+
+    # ------------------------------------------------------------- plumbing
+
+    def emit(self, record: dict) -> None:
+        self.state.append(BACKCHANNEL_TOPIC, record)
+
+    def _cp_topic(self, tenant: str, doc: str) -> str:
+        return f"cp/{tenant}/{doc}"
+
+    def load_checkpoint(self, tenant: str, doc: str) -> Optional[dict]:
+        topic = self._cp_topic(tenant, doc)
+        n = self.state.length(topic)
+        return self.state.read(topic, n - 1) if n > 0 else None
+
+    def save_checkpoint(self, tenant: str, doc: str, state: dict) -> None:
+        self.state.append(self._cp_topic(tenant, doc), state)
+
+    def discover(self) -> None:
+        for prefix in self.topic_prefixes:
+            for topic in self.shared.list_topics(prefix):
+                if topic not in self._known:
+                    self._known.add(topic)
+                    self.attach(topic)
+
+    def run_forever(self) -> None:
+        print("READY", flush=True)
+        last_discover = 0.0
+        while True:
+            now = time.monotonic()
+            if now - last_discover >= 0.25:  # listdir is not free at 2ms
+                last_discover = now
+                self.discover()
+            moved = self.shared.poll()
+            if moved:
+                self.shared.drain()
+            now = time.monotonic()
+            if now - self._last_checkpoint >= self.checkpoint_every_s:
+                self._last_checkpoint = now
+                self.checkpoint()
+            self.state.flush()
+            if not moved:
+                time.sleep(POLL_INTERVAL_S)
+
+    # ------------------------------------------------------------ per-stage
+
+    def attach(self, topic: str) -> None:
+        raise NotImplementedError
+
+    def checkpoint(self) -> None:
+        pass
+
+
+class ScribeStage(_StageHostBase):
+    """ScribeLambda per doc, out of process (scribe/lambda.ts role)."""
+
+    # uploads BEFORE deltas: an upload announcement always precedes its
+    # SUMMARIZE op on disk (the core appends + flushes it during the
+    # storage RPC, before the client can submit), and the poll/drain
+    # cycle visits topics in subscription order — so validation never
+    # sees a summarize whose upload record it hasn't ingested yet
+    topic_prefixes = ("uploads/", "deltas/")
+
+    def __init__(self, log_dir: str, state_dir: str):
+        super().__init__(log_dir, state_dir)
+        self.db = InMemoryDb()
+        self.scribes: dict[str, object] = {}  # "tenant/doc" → ScribeLambda
+
+    def _scribe_for(self, tenant: str, doc: str):
+        from .scribe import ScribeLambda
+
+        key = f"{tenant}/{doc}"
+        scribe = self.scribes.get(key)
+        if scribe is None:
+            cp = self.load_checkpoint(tenant, doc)
+
+            def send_raw(raw, tenant=tenant, doc=doc):
+                # summary ack/nack → core orders it into the stream
+                self.emit({"kind": "raw", "tenant": tenant, "doc": doc,
+                           "raw": raw})
+
+            def persist_version(handle, version, tenant=tenant, doc=doc):
+                self.emit({"kind": "version", "tenant": tenant, "doc": doc,
+                           "handle": handle, "version": dict(version)})
+
+            def on_committed(capture_seq, tenant=tenant, doc=doc):
+                self.emit({"kind": "retention", "tenant": tenant,
+                           "doc": doc, "capture_seq": capture_seq})
+
+            scribe = self.scribes[key] = ScribeLambda(
+                tenant, doc, self.db,
+                send_to_deli=send_raw,
+                checkpoint=cp["scribe"] if cp else None,
+                on_summary_committed=on_committed,
+                persist_version=persist_version,
+            )
+        return scribe
+
+    def attach(self, topic: str) -> None:
+        tenant, doc = _doc_of(topic)
+        scribe = self._scribe_for(tenant, doc)
+        if topic.startswith("deltas/"):
+            cp = self.load_checkpoint(tenant, doc)
+            start = cp["deltas_offset"] + 1 if cp else 0
+            self.shared.subscribe(topic, scribe.handler, from_offset=start)
+        else:  # uploads/: version records announced by the core
+
+            def on_upload(message, col=summary_versions_collection(
+                    tenant, doc)):
+                rec = message.value
+                self.db.upsert(col, rec["version_id"], dict(rec["record"]))
+
+            self.shared.subscribe(topic, on_upload, from_offset=0)
+
+    def checkpoint(self) -> None:
+        for key, scribe in self.scribes.items():
+            tenant, doc = key.split("/", 1)
+            self.save_checkpoint(tenant, doc, {
+                "scribe": scribe.checkpoint_state(),
+                "deltas_offset": scribe.last_offset,
+            })
+
+
+class ApplierStage(_StageHostBase):
+    """TpuDocumentApplier out of process: device work off the core GIL."""
+
+    def __init__(self, log_dir: str, state_dir: str,
+                 max_docs: int = 64, max_slots: int = 256,
+                 ds_id: str = "default", channel_id: str = "text"):
+        super().__init__(log_dir, state_dir)
+        from .tpu_applier import TpuDocumentApplier, load_applier_checkpoint
+
+        self.ds_id, self.channel_id = ds_id, channel_id
+        ckpt = os.path.join(state_dir, "applier")
+        if os.path.exists(ckpt + ".json"):
+            self.applier = load_applier_checkpoint(ckpt)
+        else:
+            self.applier = TpuDocumentApplier(max_docs=max_docs,
+                                              max_slots=max_slots)
+        self.applier.set_replay_source(lambda t, d: [])
+        self._ckpt_path = ckpt
+        self._offsets: dict[str, int] = {}
+
+    def attach(self, topic: str) -> None:
+        tenant, doc = _doc_of(topic)
+        cp = self.load_checkpoint(tenant, doc)
+        start = cp["offset"] + 1 if cp else 0
+
+        def on_deltas(message, tenant=tenant, doc=doc, topic=topic):
+            self._offsets[topic] = message.offset
+            value = message.value
+            batch = value.get("boxcar")
+            msgs = batch if batch is not None else [value["message"]]
+            # replay idempotency: the farm checkpoint is saved BEFORE
+            # the offset checkpoints, so a crash in between replays a
+            # window of already-applied ops — skip by sequence number
+            # (double-applying an insert would corrupt the doc)
+            applied = self.applier.applied_seq(tenant, doc)
+            pairs = []
+            for m in msgs:
+                if m.sequence_number <= applied:
+                    continue
+                if m.type is not MessageType.OPERATION:
+                    continue
+                env = m.contents
+                if type(env) is not dict or env.get("kind") != "chanop" \
+                        or env.get("address") != self.ds_id:
+                    continue
+                inner = env["contents"]
+                if inner.get("address") != self.channel_id \
+                        or "attach" in inner:
+                    continue
+                pairs.append((m, inner["contents"]))
+            if pairs:
+                self.applier.ingest_batch(tenant, doc, pairs)
+
+        self.shared.subscribe(topic, on_deltas, from_offset=start)
+
+    def checkpoint(self) -> None:
+        from .tpu_applier import save_applier_checkpoint
+
+        self.applier.flush()
+        self.applier.finalize()
+        save_applier_checkpoint(self.applier, self._ckpt_path)
+        for topic, offset in self._offsets.items():
+            tenant, doc = _doc_of(topic)
+            self.save_checkpoint(tenant, doc, {"offset": offset})
+            self.emit({"kind": "applied", "tenant": tenant, "doc": doc,
+                       "applied_seq": self.applier.applied_seq(tenant, doc)})
+
+
+STAGES = {"scribe": ScribeStage, "applier": ApplierStage}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="pipeline stage process")
+    parser.add_argument("--stage", choices=sorted(STAGES), required=True)
+    parser.add_argument("--log-dir", required=True,
+                        help="the core's durable log directory (read-only)")
+    parser.add_argument("--state-dir", required=True,
+                        help="this stage's own writable log directory")
+    args = parser.parse_args()
+    signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
+    STAGES[args.stage](args.log_dir, args.state_dir).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Synthetic SharedString editor for load generation — import-light.
+
+Extracted from load_gen.py so socket load WORKERS (load_async.py, one
+process per CPU-starved core slice) import only the protocol layer:
+load_gen pulls in LocalServer and, transitively, the JAX stack — ~2s of
+single-core CPU per worker process, which on the 1-core bench host was
+charged against the measured trial.
+
+Ref: packages/test/service-load-test/src/nodeStressTest.ts (the
+reference's synthetic client op source).
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..protocol.messages import DocumentMessage, MessageType
+
+DS_ID = "default"
+CHANNEL_ID = "text"
+
+_TEXT_POOL = "abcdefgh" * 4  # payload source: slicing beats per-char joins
+
+
+class SyntheticEditor:
+    """One synthetic client's op source for one document.
+
+    Generation is deliberately cheap (single ``random()`` draws scaled to
+    ranges, pooled payload text): at the north-star rate the generator
+    runs inside the measured loop, so its cost is part of the headline.
+    """
+
+    def __init__(self, rng: random.Random, remove_fraction: float = 0.3,
+                 annotate_fraction: float = 0.05, max_insert: int = 8):
+        self.rng = rng
+        self.length = 0  # lower bound on this perspective's visible length
+        self.remove_fraction = remove_fraction
+        self.annotate_fraction = annotate_fraction
+        self.max_insert = max_insert
+        self.client_seq = 0
+        self.ref_seq = 0
+
+    def observe(self, msg) -> None:
+        """Track a broadcast sequenced message (anyone's, including own)."""
+        self.ref_seq = msg.sequence_number
+        if msg.type != MessageType.OPERATION:
+            return
+        env = msg.contents
+        if type(env) is not dict or env.get("kind") != "chanop":
+            return
+        op = env["contents"]["contents"]
+        self._track(op)
+
+    def _track(self, op: dict) -> None:
+        t = op["type"]
+        if t == 0:
+            self.length += len(op.get("text") or "￼")
+        elif t == 1:
+            self.length -= op["end"] - op["start"]
+            if self.length < 0:
+                self.length = 0
+
+    def next_ops(self, count: int) -> list[DocumentMessage]:
+        """Generate a submission batch (one outbound boxcar)."""
+        rnd = self.rng.random
+        rm, ann, mi = self.remove_fraction, self.annotate_fraction, self.max_insert
+        ref_seq = self.ref_seq
+        cseq = self.client_seq
+        out = []
+        for _ in range(count):
+            r = rnd()
+            length = self.length
+            if length > 4 and r < rm:
+                a = int(rnd() * (length - 1))
+                b = a + 1 + int(rnd() * min(length - a - 1, mi - 1))
+                op = {"type": 1, "start": a, "end": b}
+                self.length = length - (b - a)
+            elif length > 1 and r < rm + ann:
+                a = int(rnd() * (length - 1))
+                b = a + 1 + int(rnd() * min(length - a - 1, mi - 1))
+                op = {"type": 2, "start": a, "end": b,
+                      "props": {"k": int(rnd() * 4)}}
+            else:
+                n = 1 + int(rnd() * mi)
+                off = int(rnd() * 8)
+                op = {"type": 0, "pos": int(rnd() * (length + 1)),
+                      "text": _TEXT_POOL[off:off + n]}
+                self.length = length + n
+            cseq += 1
+            out.append(DocumentMessage(
+                client_sequence_number=cseq,
+                reference_sequence_number=ref_seq,
+                type=MessageType.OPERATION,
+                contents={"kind": "chanop", "address": DS_ID,
+                          "contents": {"address": CHANNEL_ID, "contents": op}},
+            ))
+        self.client_seq = cseq
+        return out
+
+    def next_op(self) -> DocumentMessage:
+        return self.next_ops(1)[0]

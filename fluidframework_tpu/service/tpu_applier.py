@@ -895,9 +895,27 @@ def save_applier_checkpoint(applier: "TpuDocumentApplier",
         "restore_applied": {str(k): v
                             for k, v in applier._restore_applied.items()},
     }
-    np.savez_compressed(path + ".npz", **arrays)
-    with open(path + ".json", "w") as f:
+    # crash-atomic commit: a periodic saver can be SIGKILLed mid-write,
+    # and a torn .npz must never be what a restart loads. The arrays go
+    # to an alternating generation file; the .json (which NAMES the
+    # generation) is renamed into place last — the rename is the commit
+    # point, and the previous consistent pair survives until then.
+    import os as _os
+
+    gen = int(meta.get("gen", 0))
+    try:
+        with open(path + ".json") as f:
+            gen = 1 - int(_json.load(f).get("gen", 0))
+    except (OSError, ValueError):
+        pass
+    meta["gen"] = gen
+    npz_path = f"{path}.g{gen}.npz"
+    with open(npz_path + ".tmp", "wb") as f:
+        np.savez_compressed(f, **arrays)
+    _os.replace(npz_path + ".tmp", npz_path)
+    with open(path + ".json.tmp", "w") as f:
         _json.dump(meta, f)
+    _os.replace(path + ".json.tmp", path + ".json")
 
 
 def load_applier_checkpoint(path: str, **applier_kwargs
@@ -912,7 +930,11 @@ def load_applier_checkpoint(path: str, **applier_kwargs
     applier = TpuDocumentApplier(max_docs=meta["max_docs"],
                                  max_slots=meta["max_slots"],
                                  **applier_kwargs)
-    data = np.load(path + ".npz")
+    # generation-named arrays (crash-atomic saver); plain ".npz" is the
+    # legacy single-generation layout (tests/golden pins it loadable)
+    npz_path = (f"{path}.g{meta['gen']}.npz" if "gen" in meta
+                else path + ".npz")
+    data = np.load(npz_path)
     applier.state = _DS(**{k: jnp.asarray(data[k]) for k in data.files})
     for slot, text in enumerate(meta["arenas"]):
         arena = TextArena()

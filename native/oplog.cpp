@@ -43,12 +43,17 @@ struct Topic {
     FILE* index = nullptr;
     std::vector<uint64_t> offsets;  // byte offset of each record
     uint64_t data_end = 0;
+    bool dirty = false;  // appended-to since the last flush/sync
 };
 
 struct OpLog {
     std::string dir;
     std::map<std::string, Topic> topics;
     std::mutex mu;
+    // consumer-process handles: never truncate (recovery is the single
+    // writer's job — a reader truncating a live writer's ragged tail
+    // would silently shift the writer's record ordinals)
+    bool readonly = false;
 };
 
 bool valid_topic_name(const char* t) {
@@ -66,9 +71,12 @@ Topic* get_topic(OpLog* log, const char* name) {
     Topic t;
     std::string base = log->dir + "/" + name;
     std::string dpath = base + ".data", ipath = base + ".idx";
-    t.data = fopen(dpath.c_str(), "ab+");
-    t.index = fopen(ipath.c_str(), "ab+");
+    const char* mode = log->readonly ? "rb" : "ab+";
+    t.data = fopen(dpath.c_str(), mode);
+    t.index = fopen(ipath.c_str(), mode);
     if (!t.data || !t.index) {
+        // readonly: the producer has not created this topic yet — the
+        // caller (oplog_refresh) retries later; not cached as a failure
         if (t.data) fclose(t.data);
         if (t.index) fclose(t.index);
         return nullptr;
@@ -83,7 +91,8 @@ Topic* get_topic(OpLog* log, const char* name) {
     // tail and silently corrupts the ordinals of later records
     fseek(t.index, 0, SEEK_END);
     uint64_t index_bytes = (uint64_t)ftell(t.index);
-    if (index_bytes != t.offsets.size() * sizeof(uint64_t)) {
+    if (index_bytes != t.offsets.size() * sizeof(uint64_t) &&
+        !log->readonly) {
         if (truncate_file(t.index,
                           t.offsets.size() * sizeof(uint64_t)) != 0) {
             fclose(t.data);
@@ -116,15 +125,21 @@ Topic* get_topic(OpLog* log, const char* name) {
     }
     if (valid < t.offsets.size() || valid_end < t.data_end) {
         t.offsets.resize(valid);
-        fflush(t.index);
-        fflush(t.data);
-        if (truncate_file(t.index, valid * sizeof(uint64_t)) != 0 ||
-            truncate_file(t.data, valid_end) != 0) {
-            fclose(t.data);
-            fclose(t.index);
-            return nullptr;
+        if (log->readonly) {
+            // in-memory drop only: the tail may simply be mid-write by
+            // the live producer; oplog_refresh re-admits it once whole
+            t.data_end = valid_end;
+        } else {
+            fflush(t.index);
+            fflush(t.data);
+            if (truncate_file(t.index, valid * sizeof(uint64_t)) != 0 ||
+                truncate_file(t.data, valid_end) != 0) {
+                fclose(t.data);
+                fclose(t.index);
+                return nullptr;
+            }
+            t.data_end = valid_end;
         }
-        t.data_end = valid_end;
     }
     auto res = log->topics.emplace(name, std::move(t));
     return &res.first->second;
@@ -139,6 +154,16 @@ void* oplog_open(const char* dir) {
     mkdir(dir, 0755);  // EEXIST is fine
     auto* log = new OpLog();
     log->dir = dir;
+    return log;
+}
+
+// Consumer-process handle: reads and tails topics another process
+// writes; never creates or truncates files.
+void* oplog_open_readonly(const char* dir) {
+    if (!dir) return nullptr;
+    auto* log = new OpLog();
+    log->dir = dir;
+    log->readonly = true;
     return log;
 }
 
@@ -179,6 +204,7 @@ int64_t oplog_append(void* handle, const char* topic, const void* data,
     }
     t->data_end = record_start + sizeof(len32) + (uint64_t)len;
     t->offsets.push_back(record_start);
+    t->dirty = true;
     return (int64_t)t->offsets.size() - 1;
 }
 
@@ -208,6 +234,60 @@ int64_t oplog_read(void* handle, const char* topic, int64_t offset, void* buf,
     if ((int64_t)len > buflen) return (int64_t)len;
     if (len > 0 && fread(buf, 1, len, t->data) != len) return -1;
     return (int64_t)len;
+}
+
+// Push buffered appends into the OS page cache (fflush, no fsync) so a
+// CONSUMER PROCESS sharing the directory can see them via oplog_refresh.
+// The per-stage process composition (service/stage_runner.py) flushes at
+// drain-batch boundaries: visibility, not durability — durability stays
+// on oplog_sync at checkpoint boundaries.
+int oplog_flush(void* handle) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    for (auto& kv : log->topics) {
+        if (!kv.second.dirty) continue;  // O(appended), not O(topics)
+        fflush(kv.second.data);
+        fflush(kv.second.index);
+        kv.second.dirty = false;
+    }
+    return 0;
+}
+
+// Re-scan the on-disk index tail for records appended by ANOTHER process
+// sharing this directory; returns the refreshed record count (or -1).
+// Only COMPLETE records (index entry present AND the data extent covers
+// the whole record) are admitted — a record mid-write by the producer
+// stays invisible until its bytes land, so tailing never sees a torn
+// record. Unlike recovery, nothing is truncated here.
+int64_t oplog_refresh(void* handle, const char* topic) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !topic) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    Topic* t = get_topic(log, topic);
+    if (!t) return -1;
+    fseek(t->index, 0, SEEK_END);
+    uint64_t index_bytes = (uint64_t)ftell(t->index);
+    size_t disk_n = (size_t)(index_bytes / sizeof(uint64_t));
+    size_t have = t->offsets.size();
+    if (disk_n <= have) return (int64_t)have;
+    fseek(t->data, 0, SEEK_END);
+    uint64_t data_bytes = (uint64_t)ftell(t->data);
+    fseek(t->index, (long)(have * sizeof(uint64_t)), SEEK_SET);
+    uint64_t off;
+    uint64_t new_end = t->data_end;
+    while (t->offsets.size() < disk_n &&
+           fread(&off, sizeof(off), 1, t->index) == 1) {
+        uint32_t len = 0;
+        if (off + sizeof(len) > data_bytes) break;
+        fseek(t->data, (long)off, SEEK_SET);
+        if (fread(&len, sizeof(len), 1, t->data) != 1) break;
+        if (off + sizeof(len) + len > data_bytes) break;
+        t->offsets.push_back(off);
+        new_end = off + sizeof(len) + (uint64_t)len;
+    }
+    if (new_end > t->data_end) t->data_end = new_end;
+    return (int64_t)t->offsets.size();
 }
 
 // Make everything appended so far durable (fflush + fsync).

@@ -124,3 +124,81 @@ def test_sentinel_fields():
         timestamp=123.5)
     _, out = binwire.decode_ops(binwire.encode_ops([msg]))
     assert out == [msg]
+
+
+def test_spliced_encode_equals_full_encode():
+    """encode_ops_spliced (payload bytes reused from the submit frame)
+    must decode to exactly what encode_ops produces for the deli
+    fast-lane shape: contents objects shared with the submit decode."""
+    rng = random.Random(11)
+    for trial in range(20):
+        ops = [_rand_doc_msg(rng, i + 1) for i in range(rng.randrange(1, 24))]
+        for op in ops:
+            if op.contents is None:  # splice keys by contents identity
+                op.contents = {"x": 1}
+        body = binwire.encode_submit(ops)
+        _, decoded, spans, blob, npool = binwire.decode_submit(
+            body, with_spans=True)
+        msgs = [
+            SequencedDocumentMessage(
+                client_id="client-1", sequence_number=100 + i,
+                minimum_sequence_number=90 + i,
+                client_sequence_number=op.client_sequence_number,
+                reference_sequence_number=op.reference_sequence_number,
+                type=op.type, contents=op.contents, metadata=op.metadata,
+                timestamp=12.5,
+                traces=list(op.traces) + [TraceHop(
+                    service="deli", action="sequence", timestamp=13.0)])
+            for i, op in enumerate(decoded)
+        ]
+        spliced = binwire.encode_ops_spliced(msgs, spans, blob, npool)
+        assert spliced is not None
+        _, out = binwire.decode_ops(spliced)
+        _, ref = binwire.decode_ops(binwire.encode_ops(msgs))
+        assert out == ref == msgs
+        # fops variant strips back to the identical ops body
+        fops = binwire.encode_ops_spliced(msgs, spans, blob, npool,
+                                          topic="t/doc")
+        topic, stripped = binwire.fops_strip_topic(fops)
+        assert topic == "t/doc"
+        _, out2 = binwire.decode_ops(stripped)
+        assert out2 == msgs
+    # unknown contents → None (caller falls back)
+    foreign = SequencedDocumentMessage(
+        client_id="c", sequence_number=1, minimum_sequence_number=1,
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"other": True}, timestamp=1.0)
+    assert binwire.encode_ops_spliced([foreign], spans, blob, npool) is None
+
+
+def test_scan_ops_matches_decode():
+    """scan_ops must agree with the full decode on identity fields and
+    visible-length deltas."""
+    rng = random.Random(12)
+    for trial in range(20):
+        msgs = [_rand_seq_msg(rng, s + 1) for s in range(rng.randrange(1, 30))]
+        body = binwire.encode_ops(msgs)
+        scanned = list(binwire.scan_ops(body))
+        assert len(scanned) == len(msgs)
+        for m, (cid, seq, cseq, deli_ts, delta) in zip(msgs, scanned):
+            assert cid == m.client_id
+            assert seq == m.sequence_number
+            assert cseq == m.client_sequence_number
+            expect_deli = None
+            for t in m.traces:
+                if t.service == "deli":
+                    expect_deli = t.timestamp
+            assert deli_ts == expect_deli
+            # only fast-path records carry a delta: the generic JSON
+            # payload (non-OPERATION type, metadata, origin) scans as 0
+            fast = (m.type is MessageType.OPERATION
+                    and m.metadata is None and m.origin is None)
+            env = m.contents if isinstance(m.contents, dict) else {}
+            op = (env.get("contents") or {}).get("contents") \
+                if fast and env.get("kind") == "chanop" else None
+            if op and op.get("type") == 0:
+                assert delta == len(op["text"].encode())
+            elif op and op.get("type") == 1:
+                assert delta == op["start"] - op["end"]
+            else:
+                assert delta == 0
