@@ -321,3 +321,17 @@ def test_mixed_protocol_clients_through_gateway(topology):
     sj.insert_text(0, "json+")
     assert wait_for(lambda: sb.get_text() == "json+binary"
                     and sj.get_text() == "json+binary")
+
+
+def test_table_doc_example_demo_converges():
+    """The composed example (matrix + map + string in ONE container,
+    ref: table-document): two editor processes edit concurrently —
+    including a row insert racing cell writes — and render identical
+    tables."""
+    out = subprocess.run(
+        [sys.executable, "-m", "examples.table_doc"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED" in out.stdout
+    assert "TOTAL" in out.stdout
+    assert "region" in out.stdout
