@@ -181,9 +181,14 @@ def run_demo() -> int:
         assert ana.stdout.readline().strip() == "READY"
         editors = [ana, spawn("raj", "b")]
         results = []
-        for p in editors:
-            out, _ = p.communicate(timeout=120)
-            results.append(json.loads(out.strip().splitlines()[-1]))
+        try:
+            for p in editors:
+                out, _ = p.communicate(timeout=120)
+                results.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            for p in editors:  # a hung editor must not outlive the demo
+                if p.poll() is None:
+                    p.kill()
         for r in results:
             print(f"--- {r['name']} ---")
             print(r["render"])

@@ -59,14 +59,10 @@ class PermutationVector:
     def handle_at(self, pos: int, perspective: Optional[Perspective] = None) -> int:
         """The stable handle of the item at ``pos`` in the given view."""
         persp = perspective or self.mc.local_view()
-        i, off = self.mc.tree.resolve(pos, persp)
-        segs = self.mc.tree.segments
-        if off == 0:  # boundary: the char AT pos starts the next visible seg
-            while i < len(segs) and segs[i].visible_length(persp) == 0:
-                i += 1
-            if i >= len(segs):
-                raise IndexError(f"position {pos} out of range")
-        return ord(segs[i].text[off]) - HANDLE_BASE
+        seg, off = self.mc.tree.visible_segment_at(pos, persp)
+        if seg is None:
+            raise IndexError(f"position {pos} out of range")
+        return ord(seg.text[off]) - HANDLE_BASE
 
     def position_of_handle(self, handle: int) -> Optional[int]:
         """CURRENT local position of a handle (None if its item is gone)."""

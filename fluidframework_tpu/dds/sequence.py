@@ -48,12 +48,11 @@ class SharedSequence(SharedString):
         return items[start:end]
 
     def get_item(self, pos: int) -> Any:
-        idx, _ = self.client.tree.resolve(pos, self.client.local_view())
-        segs = self.client.tree.segments
-        view = self.client.local_view()
-        while idx < len(segs) and segs[idx].visible_length(view) == 0:
-            idx += 1
-        return segs[idx].marker[ITEM_KEY]
+        seg, _ = self.client.tree.visible_segment_at(
+            pos, self.client.local_view())
+        if seg is None:
+            raise IndexError(pos)
+        return seg.marker[ITEM_KEY]
 
     def item_count(self) -> int:
         return self.client.get_length()

@@ -69,11 +69,20 @@ class PendingOp:
 
 
 class MergeTreeClient:
-    def __init__(self, client_id: str):
+    def __init__(self, client_id: str, blocked: bool = True):
         self.client_id = client_id
         self._ids: dict[str, int] = {client_id: 0}
         self._my_ids: set[str] = {client_id}
-        self.tree = MergeTree()
+        # production replicas use the blocked tree (O(1) window advance,
+        # block-skipping walks — mergetree/blocked.py); the flat tree
+        # stays available as the semantics oracle the fuzz suites
+        # compare against (and the kernel-parity reference)
+        if blocked:
+            from .blocked import BlockedMergeTree
+
+            self.tree = BlockedMergeTree()
+        else:
+            self.tree = MergeTree()
         self.local_seq = 0
         self.pending: deque[PendingOp] = deque()
 
@@ -121,14 +130,7 @@ class MergeTreeClient:
     def get_properties_at(self, pos: int) -> dict:
         """Properties of the visible character at ``pos`` in the local view
         (ref: getPropertiesAtPosition, merge-tree client.ts)."""
-        view = self.local_view()
-        cum = 0
-        for seg in self.tree.segments:
-            n = seg.visible_length(view)
-            if cum <= pos < cum + n:
-                return dict(seg.props)
-            cum += n
-        raise IndexError(pos)
+        return self.tree.properties_at(pos, self.local_view())
 
     # -- local ops (optimistic apply; caller submits returned op) --------
     def insert_text_local(self, pos: int, text: str, props: Optional[dict] = None) -> InsertOp:
@@ -324,7 +326,7 @@ class MergeTreeClient:
                     # remote walk stops in front of, and a third client can
                     # later insert between the two placements.
                     pos = self.tree.position_of_segment(part, rebase_view)
-                    self.tree.segments.remove(part)
+                    self.tree.remove_segment(part)
                     self.tree.insert_segment(pos, part, rebase_view)
                     op = InsertOp(
                         pos=pos,
@@ -416,18 +418,13 @@ class MergeTreeClient:
     ) -> LocalReference:
         """Create a reference interpreting ``pos`` in an arbitrary view —
         remote interval ops anchor at the AUTHOR's (refSeq, client)
-        perspective (ref: intervalCollection op apply, sequence pkg)."""
-        idx, offset = self.tree.resolve(pos, perspective)
-        segs = self.tree.segments
-        if offset == 0:
-            # boundary: attach to the first perspective-visible segment at
-            # or after the resolution point
-            while idx < len(segs) and segs[idx].visible_length(perspective) == 0:
-                idx += 1
-        if idx >= len(segs):
+        perspective (ref: intervalCollection op apply, sequence pkg).
+        Boundary positions attach to the first perspective-visible
+        segment at or after the resolution point."""
+        seg, offset = self.tree.visible_segment_at(pos, perspective)
+        if seg is None:
             ref = LocalReference(None, 0, ref_type)
         else:
-            seg = segs[idx]
             ref = LocalReference(seg, offset, ref_type)
             seg.local_refs.append(ref)
         return ref
@@ -453,9 +450,10 @@ class MergeTreeClient:
         return snap
 
     @classmethod
-    def load(cls, client_id: str, snap: dict) -> "MergeTreeClient":
-        c = cls(client_id)
-        c.tree = MergeTree.load(
+    def load(cls, client_id: str, snap: dict,
+             blocked: bool = True) -> "MergeTreeClient":
+        c = cls(client_id, blocked=blocked)
+        c.tree = type(c.tree).load(
             {
                 **snap,
                 "segments": [

@@ -72,6 +72,35 @@ class MergeTree:
             f"position {pos} out of range (len {self.visible_length(perspective)})"
         )
 
+    def visible_segment_at(
+        self, pos: int, perspective: Perspective
+    ) -> tuple[Optional[Segment], int]:
+        """The segment holding the visible character AT ``pos`` (walking
+        past invisible segments on a boundary), with the in-segment
+        offset; (None, 0) when pos is the end of the document. The
+        shared resolve-then-walk step of reference creation and item
+        lookup — tree-structure-agnostic, unlike raw index math."""
+        idx, offset = self.resolve(pos, perspective)
+        segs = self.segments
+        if offset == 0:
+            while idx < len(segs) and \
+                    segs[idx].visible_length(perspective) == 0:
+                idx += 1
+        if idx >= len(segs):
+            return None, 0
+        return segs[idx], offset
+
+    def properties_at(self, pos: int, perspective: Perspective) -> dict:
+        """Properties of the visible character at ``pos``."""
+        seg, _ = self.visible_segment_at(pos, perspective)
+        if seg is None:
+            raise IndexError(pos)
+        return dict(seg.props)
+
+    def remove_segment(self, seg: Segment) -> None:
+        """Physically remove a segment (reconnect re-placement path)."""
+        self.segments.remove(seg)
+
     def position_of_segment(self, target: Segment, perspective: Perspective) -> int:
         """Perspective position of the first character of ``target``."""
         pos = 0
@@ -323,8 +352,14 @@ class MergeTree:
         below min_seq normalize to UNIVERSAL_SEQ so loaders treat them as
         base content; younger stamps are preserved for in-window perspective
         checks by catch-up ops.
+
+        The output is CANONICAL: adjacent text runs whose serialized
+        stamps are identical coalesce at write time, so the bytes do not
+        depend on the in-memory segmentation (flat eager-zamboni vs
+        blocked amortized-zamboni) — the snapshot-regression fingerprints
+        then pin semantics, not representation.
         """
-        segs = []
+        segs: list[dict] = []
         for seg in self.segments:
             if seg.is_pending():
                 raise RuntimeError("cannot snapshot with pending local ops")
@@ -343,7 +378,13 @@ class MergeTree:
                 d["remClient"] = seg.rem_client
                 if len(seg.rem_clients) > 1:
                     d["remClients"] = sorted(seg.rem_clients)
-            segs.append(d)
+            prev = segs[-1] if segs else None
+            if (prev is not None and "text" in prev and "text" in d
+                    and {k: v for k, v in prev.items() if k != "text"}
+                    == {k: v for k, v in d.items() if k != "text"}):
+                prev["text"] += d["text"]
+            else:
+                segs.append(d)
         return {"minSeq": self.min_seq, "seq": self.current_seq, "segments": segs}
 
     @classmethod

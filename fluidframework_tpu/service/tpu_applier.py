@@ -735,7 +735,10 @@ class TpuDocumentApplier:
             return self._host_docs[slot]
         tree = decode_state(self._device_slot(slot), self.arenas[slot],
                             self.prop_table)
-        replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
+        # flat replica: decode_state produces the flat oracle tree, so
+        # don't build (then discard) the client's default blocked one
+        replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}",
+                                  blocked=False)
         replica.tree = tree
         # carry the interning table: in-window stamps must translate back
         # to wire client ids when this replica snapshots (service

@@ -1,0 +1,215 @@
+"""BlockedMergeTree: differential fuzz vs the flat oracle + scaling.
+
+The blocked tree (mergetree/blocked.py) is the production replica path;
+the flat MergeTree stays the semantics oracle. Every test here drives
+BOTH from identical op streams and demands identical observable state —
+text, lengths, properties, canonical snapshots — across sequencing,
+concurrency, removes, annotates, markers, and window advancement.
+(The multi-client conflict/reconnect farms in test_mergetree_farm.py
+also exercise the blocked tree, since it is the client default.)
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from fluidframework_tpu.mergetree.client import MergeTreeClient
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    SequencedDocumentMessage,
+)
+
+
+class Duo:
+    """One logical client as two replicas: flat oracle + blocked."""
+
+    def __init__(self, name: str):
+        self.flat = MergeTreeClient(name, blocked=False)
+        self.blk = MergeTreeClient(name, blocked=True)
+        self.name = name
+
+    def both(self):
+        return (self.flat, self.blk)
+
+    def check(self, where: str) -> None:
+        assert self.flat.get_length() == self.blk.get_length(), where
+        assert self.flat.get_text() == self.blk.get_text(), where
+
+
+def _sequencer(duos):
+    """Minimal deli: assigns seqs; delivers to every duo (both replicas)."""
+    state = {"seq": 0}
+
+    def sequence(author: "Duo", flat_op, blk_op, ref_seq: int):
+        state["seq"] += 1
+        seq = state["seq"]
+        msn = max(0, seq - 6)
+        for duo in duos:
+            local = duo is author
+            for client, op in ((duo.flat, flat_op), (duo.blk, blk_op)):
+                msg = SequencedDocumentMessage(
+                    client_id=author.name, sequence_number=seq,
+                    minimum_sequence_number=msn,
+                    client_sequence_number=seq,
+                    reference_sequence_number=ref_seq,
+                    type=MessageType.OPERATION, contents=op)
+                client.apply_msg(msg, local=local)
+    return sequence
+
+
+def test_differential_fuzz_flat_vs_blocked():
+    rng = random.Random(42)
+    duos = [Duo("a"), Duo("b"), Duo("c")]
+    sequence = _sequencer(duos)
+
+    for step in range(600):
+        duo = rng.choice(duos)
+        ref_seq = duo.flat.tree.current_seq
+        assert ref_seq == duo.blk.tree.current_seq
+        n = duo.flat.get_length()
+        r = rng.random()
+        if n > 4 and r < 0.3:
+            a = rng.randrange(n - 1)
+            b = a + 1 + rng.randrange(min(n - a - 1, 9) + 1)
+            flat_op = duo.flat.remove_range_local(a, b)
+            blk_op = duo.blk.remove_range_local(a, b)
+        elif n > 2 and r < 0.42:
+            a = rng.randrange(n - 1)
+            b = a + 1 + rng.randrange(min(n - a - 1, 6) + 1)
+            props = {"k": rng.randrange(4)}
+            flat_op = duo.flat.annotate_range_local(a, b, props)
+            blk_op = duo.blk.annotate_range_local(a, b, props)
+        elif r < 0.47:
+            pos = rng.randrange(n + 1)
+            marker = {"kind": "m", "v": step}
+            flat_op = duo.flat.insert_marker_local(pos, marker)
+            blk_op = duo.blk.insert_marker_local(pos, marker)
+        else:
+            pos = rng.randrange(n + 1)
+            text = "abcdefgh"[: 1 + rng.randrange(6)]
+            flat_op = duo.flat.insert_text_local(pos, text)
+            blk_op = duo.blk.insert_text_local(pos, text)
+        duo.check(f"step {step} local")
+        sequence(duo, flat_op, blk_op, ref_seq)
+        for d in duos:
+            d.check(f"step {step} after seq")
+        if rng.random() < 0.1:
+            n2 = duo.flat.get_length()
+            if n2:
+                p = rng.randrange(n2)
+                try:
+                    pf = duo.flat.get_properties_at(p)
+                    pb = duo.blk.get_properties_at(p)
+                    assert pf == pb, f"step {step} props@{p}"
+                except IndexError:
+                    pass
+
+    # fully acked: canonical snapshots must be byte-identical
+    for d in duos:
+        assert not d.flat.pending and not d.blk.pending
+        assert d.flat.snapshot() == d.blk.snapshot()
+
+
+def test_snapshot_canonical_across_representations():
+    """Snapshot bytes must not depend on in-memory segmentation: load a
+    snapshot into both representations, mutate identically, re-snapshot,
+    compare."""
+    rng = random.Random(7)
+    duo = Duo("a")
+    sequence = _sequencer([duo])
+    for step in range(120):
+        n = duo.flat.get_length()
+        if n > 3 and rng.random() < 0.3:
+            a = rng.randrange(n - 1)
+            f = duo.flat.remove_range_local(a, a + 1)
+            b = duo.blk.remove_range_local(a, a + 1)
+        else:
+            pos = rng.randrange(n + 1)
+            f = duo.flat.insert_text_local(pos, "xy")
+            b = duo.blk.insert_text_local(pos, "xy")
+        sequence(duo, f, b, duo.flat.tree.current_seq)
+    snap_f = duo.flat.snapshot()
+    snap_b = duo.blk.snapshot()
+    assert snap_f == snap_b
+    # round trip through load on both classes
+    rf = MergeTreeClient.load("a", snap_f, blocked=False)
+    rb = MergeTreeClient.load("a", snap_f, blocked=True)
+    assert rf.get_text() == rb.get_text() == duo.flat.get_text()
+    assert rf.snapshot() == rb.snapshot() == snap_f
+
+
+def test_long_doc_latency_near_flat():
+    """VERDICT r3 item 4 'Done' criterion: client op latency on a
+    1M-char doc must not scale like the flat oracle's O(n). Measured as
+    per-op time growing < 4× from a 100k-char doc to a 1M-char doc
+    (the flat list grows ~10×), with wide margins for the shared host."""
+
+    def drive(client: MergeTreeClient, upto: int, chunk: int = 32):
+        rng = random.Random(1)
+        seq = client.tree.current_seq
+        t0 = time.perf_counter()
+        ops = 0
+        while client.get_length() < upto:
+            pos = rng.randrange(client.get_length() + 1)
+            op = client.insert_text_local(pos, "x" * chunk)
+            seq += 1
+            client.apply_msg(SequencedDocumentMessage(
+                client_id=client.client_id, sequence_number=seq,
+                minimum_sequence_number=max(0, seq - 8),
+                client_sequence_number=seq,
+                reference_sequence_number=seq - 1,
+                type=MessageType.OPERATION, contents=op), local=True)
+            ops += 1
+        return (time.perf_counter() - t0) / max(ops, 1)
+
+    c = MergeTreeClient("perf", blocked=True)
+    small = drive(c, 100_000)      # per-op cost building to 100k chars
+    drive(c, 900_000)              # grow (untimed)
+    big = drive(c, 1_000_000)      # per-op cost at ~1M chars
+    assert big < small * 4, (
+        f"per-op latency grew {big / small:.1f}x from 100k to 1M chars "
+        f"({small * 1e6:.0f}us -> {big * 1e6:.0f}us)")
+
+
+def test_escalated_replay_scales(tmp_path):
+    """VERDICT r3 item 4: an applier HOST escalation replays the doc's
+    whole op log through a MergeTreeClient — with the blocked tree that
+    replay is near-linear in op count, not quadratic. Replay a
+    ~200k-char synthetic log through the applier's escalation path and
+    bound the wall time generously."""
+    from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
+
+    rng = random.Random(3)
+    log = []
+    length = 0
+    for seq in range(1, 6001):
+        if length > 40 and rng.random() < 0.2:
+            a = rng.randrange(length - 8)
+            op = {"type": 1, "start": a, "end": a + 1 + rng.randrange(8)}
+            length -= op["end"] - op["start"]
+        else:
+            op = {"type": 0, "pos": rng.randrange(length + 1), "text": "y" * 40}
+            length += 40
+        log.append(SequencedDocumentMessage(
+            client_id="gen", sequence_number=seq,
+            minimum_sequence_number=max(0, seq - 8),
+            client_sequence_number=seq, reference_sequence_number=seq - 1,
+            type=MessageType.OPERATION, contents=op))
+
+    # tiny slot budget forces the first ingest to overflow → escalate →
+    # full-log replay on the host replica
+    applier = TpuDocumentApplier(max_docs=2, max_slots=8, ops_per_dispatch=4)
+    applier.set_replay_source(lambda t, d: log)
+    t0 = time.perf_counter()
+    for m in log[:40]:
+        applier.ingest("t", "doc", m, m.contents)
+    applier.flush()
+    applier.finalize()
+    took = time.perf_counter() - t0
+    assert applier.host_escalations == 1
+    assert len(applier.get_text("t", "doc")) == length
+    # ~6k-op replay of a 200k-char doc: seconds with the blocked tree,
+    # minutes with the old flat-list path (O(n) zamboni per op). The
+    # bound is deliberately loose for the shared bench host.
+    assert took < 30.0, f"escalation replay took {took:.1f}s"
