@@ -42,7 +42,7 @@ def record_document(server, tenant_id: str, document_id: str,
         json.dump([message_to_dict(m) for m in msgs], f)
     from .local import LocalStorage
 
-    snap = LocalStorage(server, tenant_id, document_id).get_snapshot_tree()
+    snap = server.storage(tenant_id, document_id).get_snapshot_tree()
     if snap is not None:
         with open(os.path.join(doc_dir, "snapshot.json"), "w") as f:
             json.dump(snap, f)

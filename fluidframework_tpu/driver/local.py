@@ -131,14 +131,10 @@ class LocalStorage(DocumentStorage):
         ref = json.loads(self.read_blob(version["tree_id"]).decode())
         if ref.get("t") != "tree":
             return ref  # legacy single-blob summary
-        return self._materialize({"k": "tree", "id": version["tree_id"]})
+        from ..service.summary_trees import materialize_tree
 
-    def _materialize(self, ref: dict) -> Any:
-        if ref["k"] == "blob":
-            return json.loads(self.read_blob(ref["id"]).decode())
-        node = json.loads(self.read_blob(ref["id"]).decode())
-        return {name: self._materialize(child)
-                for name, child in node["e"].items()}
+        return materialize_tree(self.read_blob,
+                                {"k": "tree", "id": version["tree_id"]})
 
     def read_blob(self, blob_id: str) -> bytes:
         return self._blobs.get(blob_id)
@@ -186,49 +182,9 @@ class LocalStorage(DocumentStorage):
         return {"k": "tree", "id": v["tree_id"]}
 
     def _upload_obj(self, obj, parent_root: Optional[dict]) -> dict:
-        from ..protocol.summary import (
-            SummaryAttachment,
-            SummaryBlob,
-            SummaryHandle,
-            SummaryTree,
-        )
+        from ..service.summary_trees import upload_summary_obj
 
-        if isinstance(obj, SummaryBlob):
-            self._stats["blobs_written"] += 1
-            return {"k": "blob", "id": self._blobs.put(obj.content)}
-        if isinstance(obj, SummaryAttachment):
-            return {"k": "blob", "id": obj.id}
-        if isinstance(obj, SummaryHandle):
-            if parent_root is None:
-                raise ValueError(
-                    f"summary handle {obj.handle!r} with no parent version")
-            ref = self._resolve_path(parent_root, obj.handle)
-            self._stats["handles_reused"] += 1
-            return ref
-        if isinstance(obj, SummaryTree):
-            entries = {
-                name: self._upload_obj(child, parent_root)
-                for name, child in obj.tree.items()
-            }
-            node = json.dumps({"t": "tree", "e": entries},
-                              sort_keys=True).encode()
-            self._stats["trees_written"] += 1
-            return {"k": "tree", "id": self._blobs.put(node)}
-        raise TypeError(f"not a summary object: {obj!r}")
-
-    def _resolve_path(self, root_ref: dict, path: str) -> dict:
-        """Walk stored tree nodes to the subtree ref a handle names.
-        Parent trees were themselves uploaded with handles resolved, so
-        the walk always lands on a concrete content id."""
-        ref = root_ref
-        for segment in path.strip("/").split("/"):
-            if ref["k"] != "tree":
-                raise KeyError(f"handle path {path!r}: {segment!r} is a blob")
-            node = json.loads(self._blobs.get(ref["id"]).decode())
-            if segment not in node["e"]:
-                raise KeyError(f"handle path {path!r}: no entry {segment!r}")
-            ref = node["e"][segment]
-        return ref
+        return upload_summary_obj(self._blobs, obj, parent_root, self._stats)
 
 
 class LocalDocumentService(DocumentService):
@@ -243,8 +199,8 @@ class LocalDocumentService(DocumentService):
     def connect_to_delta_storage(self) -> LocalDeltaStorage:
         return LocalDeltaStorage(self._server, self._tenant, self._doc)
 
-    def connect_to_storage(self) -> LocalStorage:
-        return LocalStorage(self._server, self._tenant, self._doc)
+    def connect_to_storage(self):
+        return self._server.storage(self._tenant, self._doc)
 
 
 class LocalDocumentServiceFactory(DocumentServiceFactory):

@@ -341,12 +341,14 @@ class BlockedMergeTree(MergeTree):
             return []
         affected: list[Segment] = []
         pos = 0
-        for bi, b in enumerate(self._blocks):
-            if pos >= end:
-                break
+        touched_blocks: list[int] = []
+        bi = 0
+        while bi < len(self._blocks) and pos < end:
+            b = self._blocks[bi]
             bl = b.visible_length(self, perspective)
             if pos + bl <= start:  # no overlap with [start, end)
                 pos += bl
+                bi += 1
                 continue
             i = 0
             touched = False
@@ -382,7 +384,13 @@ class BlockedMergeTree(MergeTree):
                 i += 1
             if touched:
                 b.dirty = True
-                self._split_block(bi)
+                touched_blocks.append(bi)
+            bi += 1
+        # split AFTER the walk (back to front): splitting mid-iteration
+        # would shift block indices and re-visit the inserted tail with
+        # an already-advanced pos, corrupting the range accounting
+        for bj in reversed(touched_blocks):
+            self._split_block(bj)
         return affected
 
     def annotate_range(
@@ -397,12 +405,14 @@ class BlockedMergeTree(MergeTree):
             return []
         affected: list[Segment] = []
         pos = 0
-        for bi, b in enumerate(self._blocks):
-            if pos >= end:
-                break
+        touched_blocks: list[int] = []
+        bi = 0
+        while bi < len(self._blocks) and pos < end:
+            b = self._blocks[bi]
             bl = b.visible_length(self, perspective)
             if pos + bl <= start:
                 pos += bl
+                bi += 1
                 continue
             i = 0
             touched = False
@@ -429,7 +439,11 @@ class BlockedMergeTree(MergeTree):
                 i += 1
             if touched:
                 b.dirty = True
-                self._split_block(bi)
+                touched_blocks.append(bi)
+            bi += 1
+        # see mark_removed: splits are deferred past the walk
+        for bj in reversed(touched_blocks):
+            self._split_block(bj)
         return affected
 
     def remove_segment(self, seg: Segment) -> None:

@@ -465,9 +465,7 @@ class _ClientSession:
                          required_scope=SCOPE_WRITE if write else SCOPE_READ)
 
     def _handle_storage(self, t: str, frame: dict, rid) -> None:
-        from ..driver.local import LocalStorage
-
-        storage = LocalStorage(self.front.server, frame["tenant"], frame["doc"])
+        storage = self.front.server.storage(frame["tenant"], frame["doc"])
         if t == "get_versions":
             self.push("versions", {
                 "rid": rid,
@@ -710,6 +708,9 @@ def main() -> None:
                              "is its single writer)")
     parser.add_argument("--storage-dir", default=None,
                         help="native chunk-store directory for blobs")
+    parser.add_argument("--storage-server", default=None, metavar="PORT",
+                        help="route ALL storage to a storage_server.py "
+                             "process (host:port or port on localhost)")
     parser.add_argument("--external-scribe", action="store_true",
                         help="scribe runs out of process; summary "
                              "uploads are announced on the log")
@@ -727,15 +728,20 @@ def main() -> None:
             tid, _, secret = spec.partition(":")
             tenants.register(tid, secret)
     if args.tenant or args.log_dir or args.storage_dir \
-            or args.external_scribe:
+            or args.external_scribe or args.storage_server:
         log = None
         if args.log_dir:
             from .durable_log import DurableLog
 
             log = DurableLog(args.log_dir)
+        storage_server = None
+        if args.storage_server:
+            host, _, port = args.storage_server.rpartition(":")
+            storage_server = (host or "127.0.0.1", int(port))
         server = LocalServer(tenants=tenants, log=log,
                              storage_dir=args.storage_dir,
-                             external_scribe=args.external_scribe)
+                             external_scribe=args.external_scribe,
+                             storage_server=storage_server)
         if args.external_scribe:
             def announce_upload(tenant, doc, vid, rec, server=server):
                 server.log.append(f"uploads/{tenant}/{doc}",

@@ -83,7 +83,11 @@ class LocalOrderer:
         logger=None,
         log_retention_ops: Optional[int] = None,
         external_scribe: bool = False,
+        on_version_persisted=None,
     ):
+        # fires once per newly-acked version, after the durable append —
+        # the storage-process deployment advances the doc's named ref here
+        self._on_version_persisted = on_version_persisted
         self.tenant_id = tenant_id
         self.document_id = document_id
         self._log = log
@@ -185,6 +189,8 @@ class LocalOrderer:
         scribe's backchannel both land here)."""
         self._log.append(_versions_topic(self.tenant_id, self.document_id),
                          {"handle": handle, "version": dict(version)})
+        if self._on_version_persisted is not None:
+            self._on_version_persisted(handle, dict(version))
 
     def apply_retention(self, capture_seq: int) -> None:
         """Truncate ops an acked summary covers, minus the in-flight

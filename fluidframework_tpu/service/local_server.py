@@ -134,6 +134,7 @@ class LocalServer:
         config=None,
         tenants=None,
         external_scribe: bool = False,
+        storage_server=None,
     ):
         from ..config import DEFAULT
         from ..utils import TelemetryLogger
@@ -161,6 +162,15 @@ class LocalServer:
             from .blob_store import DbBlobStore
 
             self.blob_store = DbBlobStore(self.db)
+        # storage as its own PROCESS (storage_server.py — the
+        # gitrest+historian role): all storage reads/writes and the
+        # scribe's ref updates route to it instead of this process's
+        # blob store
+        self._storage_conn = None
+        if storage_server is not None:
+            from .storage_client import StorageConnection
+
+            self._storage_conn = StorageConnection(*storage_server)
         # summary-upload accounting (handle reuse), per server
         self.storage_stats = {"handles_reused": 0, "trees_written": 0,
                               "blobs_written": 0}
@@ -247,6 +257,39 @@ class LocalServer:
         self._maybe_drain()
         return conn
 
+    def storage(self, tenant_id: str, document_id: str):
+        """The doc's storage binding: the in-proc store, or the storage
+        PROCESS when one is deployed. Every storage consumer (front-end
+        RPCs, summarizer, drivers) goes through here."""
+        if self._storage_conn is not None:
+            from .storage_client import RemoteStorage
+
+            def on_uploaded(vid, record, tenant=tenant_id,
+                            doc=document_id):
+                # mirror the version record into this process's db —
+                # scribe validation reads it there — and announce it
+                # (external scribe stages learn of uploads this way)
+                from .core import summary_versions_collection
+
+                self.db.upsert(summary_versions_collection(tenant, doc),
+                               vid, dict(record))
+                hook = self.on_version_uploaded
+                if hook is not None:
+                    hook(tenant, doc, vid, dict(record))
+            return RemoteStorage(self._storage_conn, tenant_id,
+                                 document_id, on_uploaded=on_uploaded)
+        from ..driver.local import LocalStorage
+
+        return LocalStorage(self, tenant_id, document_id)
+
+    def commit_storage_ref(self, tenant_id: str, document_id: str,
+                           handle: str) -> None:
+        """Advance the doc's named head in the storage process after a
+        scribe ack (no-op for in-proc storage, whose acked flag plays
+        the ref role)."""
+        if self._storage_conn is not None:
+            self.storage(tenant_id, document_id).commit_ref(handle)
+
     def get_deltas(
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
     ) -> list[SequencedDocumentMessage]:
@@ -288,11 +331,17 @@ class LocalServer:
             if self._client_timeout is not None:
                 kw["client_timeout"] = self._client_timeout
             retention = self.config.log_retention_ops
+            on_persisted = None
+            if self._storage_conn is not None:
+                def on_persisted(handle, version, t=tenant_id,
+                                 d=document_id):
+                    self.commit_storage_ref(t, d, handle)
             self._orderers[key] = LocalOrderer(
                 tenant_id, document_id, self.log, self.db, self.pubsub,
                 clock=self._clock, logger=self.logger,
                 log_retention_ops=retention if retention >= 0 else None,
                 external_scribe=self.external_scribe,
+                on_version_persisted=on_persisted,
                 **kw)
         return self._orderers[key]
 

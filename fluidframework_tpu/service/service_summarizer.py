@@ -18,7 +18,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..driver.local import LocalStorage
 
 DS_ID = "default"
 TEXT_CHANNEL = "text"
@@ -67,7 +66,7 @@ class ServiceSummarizer:
             },
             "sequence_number": scribe.protocol.sequence_number,
         }
-        storage = LocalStorage(self.server, tenant_id, document_id)
+        storage = self.server.storage(tenant_id, document_id)
         version_id = storage.upload_summary(
             summary, parent=scribe.last_summary_head)
         # the service is its own validator, but must still commit through
@@ -186,7 +185,7 @@ class ServiceSummarizer:
         if base > 0:
             # content below the base is only reachable through the prior
             # acked summary — it must not hold anything we would drop
-            prior = LocalStorage(self.server, tenant_id,
+            prior = self.server.storage(tenant_id,
                                  document_id).get_snapshot_tree()
             stores = ((prior or {}).get("runtime") or {}) \
                 .get("dataStores") or {}

@@ -213,3 +213,48 @@ def test_escalated_replay_scales(tmp_path):
     # minutes with the old flat-list path (O(n) zamboni per op). The
     # bound is deliberately loose for the shared bench host.
     assert took < 30.0, f"escalation replay took {took:.1f}s"
+
+
+def test_differential_fuzz_tiny_blocks(monkeypatch):
+    """Block-split paths under stress: with TARGET_BLOCK shrunk to 3,
+    every few ops split a block — the mid-walk split bug (splitting
+    while iterating blocks corrupts range accounting) only manifests
+    when splits fire during remove/annotate walks, which the default
+    96-segment blocks never reached in the main fuzz."""
+    from fluidframework_tpu.mergetree import blocked
+
+    monkeypatch.setattr(blocked, "TARGET_BLOCK", 3)
+    rng = random.Random(99)
+    duos = [Duo("a"), Duo("b")]
+    sequence = _sequencer(duos)
+    for step in range(500):
+        duo = rng.choice(duos)
+        ref_seq = duo.flat.tree.current_seq
+        n = duo.flat.get_length()
+        r = rng.random()
+        if n > 6 and r < 0.35:
+            a = rng.randrange(n - 4)
+            b = a + 1 + rng.randrange(min(n - a - 1, 20) + 1)
+            f = duo.flat.remove_range_local(a, b)
+            k = duo.blk.remove_range_local(a, b)
+        elif n > 4 and r < 0.55:
+            a = rng.randrange(n - 2)
+            b = a + 1 + rng.randrange(min(n - a - 1, 16) + 1)
+            props = {"s": rng.randrange(3)}
+            f = duo.flat.annotate_range_local(a, b, props)
+            k = duo.blk.annotate_range_local(a, b, props)
+        else:
+            pos = rng.randrange(n + 1)
+            text = "qwerty"[: 1 + rng.randrange(5)]
+            f = duo.flat.insert_text_local(pos, text)
+            k = duo.blk.insert_text_local(pos, text)
+        duo.check(f"tiny step {step} local")
+        sequence(duo, f, k, ref_seq)
+        for d in duos:
+            d.check(f"tiny step {step} after seq")
+        if rng.random() < 0.2 and duo.flat.get_length():
+            p = rng.randrange(duo.flat.get_length())
+            assert duo.flat.get_properties_at(p) \
+                == duo.blk.get_properties_at(p), f"tiny step {step} props"
+    for d in duos:
+        assert d.flat.snapshot() == d.blk.snapshot()

@@ -1,0 +1,141 @@
+"""Storage as its own process: commit/ref DAG, boot-from-ref, caching.
+
+Ref: the reference's storage micro-services (gitrest object store +
+historian caching proxy, services-client/src/gitManager.ts:13,
+historian.ts:29) — summaries live in a git-shaped commit DAG behind a
+standalone cached service; the scribe's ack advances the doc's named
+ref (VERDICT r3 item 5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import subprocess
+import sys
+import time
+
+from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service.storage_client import (
+    RemoteStorage,
+    StorageConnection,
+)
+
+
+def wait_for(cond, timeout=20.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _spawn(args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+@contextlib.contextmanager
+def storage_process(data_dir):
+    proc, port = _spawn(["fluidframework_tpu.service.storage_server",
+                         "--dir", str(data_dir)])
+    try:
+        yield port
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@contextlib.contextmanager
+def full_deployment(tmp_path):
+    """Storage process + ordering core process wired to it."""
+    with storage_process(tmp_path / "store") as sport:
+        core, port = _spawn(["fluidframework_tpu.service.front_end",
+                             "--port", "0",
+                             "--storage-server", str(sport)])
+        try:
+            yield port, sport
+        finally:
+            core.terminate()
+            core.wait(timeout=10)
+
+
+def test_commit_dag_and_history_walk(tmp_path):
+    """Direct RPC exercise: summary uploads build parent-linked commits;
+    the ref advances only on commit_ref; history walks the chain."""
+    with storage_process(tmp_path / "s") as port:
+        conn = StorageConnection("127.0.0.1", port)
+        st = RemoteStorage(conn, "t", "doc")
+        v1 = st.upload_summary({"root": {"a": 1}}, None)
+        assert st.get_ref() is None          # unacked: not yet a version
+        assert st.get_versions() == []
+        st.commit_ref(v1)
+        assert st.get_ref() == v1
+        v2 = st.upload_summary({"root": {"a": 2}}, v1)
+        st.commit_ref(v2)
+        v3 = st.upload_summary({"root": {"a": 3}}, v2)
+        st.commit_ref(v3)
+
+        commits = st.history()
+        assert [c["id"] for c in commits] == [v3, v2, v1]
+        assert [c["meta"]["n"] for c in commits] == [2, 1, 0]
+        assert commits[0]["parents"] == [v2]
+        assert commits[2]["parents"] == []
+        # newest-first version listing mirrors the walk
+        assert [v["id"] for v in st.get_versions(2)] == [v3, v2]
+        assert st.get_snapshot_tree() == {"root": {"a": 3}}
+
+
+def test_refs_survive_storage_process_restart(tmp_path):
+    data = tmp_path / "s"
+    with storage_process(data) as port:
+        st = RemoteStorage(StorageConnection("127.0.0.1", port), "t", "d")
+        v1 = st.upload_summary({"root": {"x": 1}}, None)
+        st.commit_ref(v1)
+    with storage_process(data) as port:
+        st = RemoteStorage(StorageConnection("127.0.0.1", port), "t", "d")
+        assert st.get_ref() == v1            # reflog replayed
+        assert st.get_snapshot_tree() == {"root": {"x": 1}}
+
+
+def test_client_boots_from_ref_through_storage_process(tmp_path):
+    """End to end: client summary → scribe ack advances the ref in the
+    storage PROCESS → a fresh client boots from it; blob reads hit the
+    historian-role LRU."""
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    with full_deployment(tmp_path) as (port, sport):
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c1 = loader.resolve("t", "doc")
+        sm = SummaryManager(c1, max_ops=3)
+        s = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "stored remotely")
+        assert wait_for(lambda: sm.summaries_acked >= 1)
+
+        st = RemoteStorage(StorageConnection("127.0.0.1", sport),
+                           "t", "doc")
+        head = st.get_ref()
+        assert head is not None              # scribe advanced the ref
+        assert st.history()[0]["id"] == head
+
+        c2 = loader.resolve("t", "doc")
+        assert c2._base_snapshot is not None  # booted from the summary
+        assert wait_for(lambda: c2.runtime.get_data_store("default")
+                        .get_channel("text").get_text()
+                        == "stored remotely")
+        stats = st.stats()
+        assert stats["hits"] > 0             # c2's boot re-read cached blobs
+
+        # a second summary chains onto the first
+        for i in range(4):
+            s.insert_text(0, f"{i}")
+        assert wait_for(lambda: sm.summaries_acked >= 2)
+        hist = st.history()
+        assert len(hist) == 2 and hist[1]["id"] == head
