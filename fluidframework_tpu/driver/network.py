@@ -22,6 +22,7 @@ import threading
 from typing import Any, Callable, Optional
 
 from ..protocol import binwire
+from ..protocol.messages import MessageType
 from ..protocol.serialization import message_from_dict, message_to_dict
 from .definitions import (
     DocumentDeltaConnection,
@@ -153,10 +154,14 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
     def __init__(self, transport: _Transport, tenant_id: str,
                  document_id: str, details: Any = None,
-                 token: Optional[str] = None, binary: bool = True):
+                 token: Optional[str] = None, binary: bool = True,
+                 cache=None):
         self._t = transport
         self.lock = transport.lock
         self._binary = binary
+        self._tenant = tenant_id
+        self._doc = document_id
+        self._cache = cache
         self._handlers: dict[str, Optional[Callable]] = {
             "op": None, "nack": None, "signal": None}
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
@@ -190,6 +195,11 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         self.max_message_size = reply.get("maxMessageSize")
 
     def _deliver(self, kind: str, event) -> None:
+        if kind == "op" and self._cache is not None \
+                and event.type == MessageType.SUMMARY_ACK:
+            # a newer summary committed: the cached boot snapshot is
+            # stale — drop it so the NEXT boot fetches the new head
+            self._cache.invalidate(self._tenant, self._doc)
         cb = self._handlers[kind]
         if cb is None:
             self._buffers[kind].append(event)
@@ -268,14 +278,25 @@ class NetworkDeltaStorage(DocumentDeltaStorage):
 
 
 class NetworkStorage(DocumentStorage):
+    """Snapshot storage RPCs, with an optional driver-side cache.
+
+    With a :class:`~.snapshot_cache.SnapshotCache` attached (the
+    odsp-driver lesson, odspCache.ts), a re-boot of an unchanged doc
+    serves version+tree from the cache and issues ZERO storage round
+    trips; the delta connection invalidates the entry when a newer
+    summary commits (summaryAck on the live stream)."""
+
     def __init__(self, transport: _Transport, tenant_id: str,
-                 document_id: str, token_provider=None):
+                 document_id: str, token_provider=None, cache=None):
         self._t = transport
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
+        self._cache = cache
+        self.rpcs = 0  # storage round trips issued (cache hits don't count)
 
     def _req(self, t: str, **kw) -> dict:
+        self.rpcs += 1
         token = (self._token_provider(self._tenant, self._doc)
                  if self._token_provider else None)
         return self._t.request(
@@ -283,10 +304,37 @@ class NetworkStorage(DocumentStorage):
              "token": token, **kw})
 
     def get_versions(self, count: int = 1) -> list[dict]:
+        if self._cache is not None and count == 1:
+            entry = self._cache.get(self._tenant, self._doc)
+            if entry is not None:
+                return [dict(entry["version"])]
         return self._req("get_versions", count=count)["versions"]
 
     def get_snapshot_tree(self, version: Optional[dict] = None):
-        return self._req("get_tree", version=version)["tree"]
+        if self._cache is None:
+            # uncached path: one RPC, head resolved server-side
+            return self._req("get_tree", version=version)["tree"]
+        entry = self._cache.get(self._tenant, self._doc)
+        if entry is not None and (
+                version is None
+                or version.get("id") == entry["version"].get("id")):
+            return entry["tree"]
+        if version is not None:
+            # explicit (possibly historical) version: serve it but never
+            # cache it — it must not demote a newer cached head
+            return self._req("get_tree", version=version)["tree"]
+        epoch = self._cache.epoch(self._tenant, self._doc)
+        versions = self._req("get_versions", count=1)["versions"]
+        if not versions:
+            return None
+        head = versions[0]
+        tree = self._req("get_tree", version=head)["tree"]
+        if tree is not None:
+            # epoch-guarded: if a summary ack invalidated mid-fetch,
+            # this put is dropped rather than resurrecting stale state
+            self._cache.put(self._tenant, self._doc, dict(head), tree,
+                            epoch=epoch)
+        return tree
 
     def read_blob(self, blob_id: str) -> bytes:
         return bytes.fromhex(self._req("read_blob", id=blob_id)["hex"])
@@ -317,12 +365,13 @@ class NetworkDocumentService(DocumentService):
 
     def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
                  timeout: float = 30.0, token_provider=None,
-                 binary: bool = True):
+                 binary: bool = True, cache=None):
         self._host, self._port, self._timeout = host, port, timeout
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
         self._binary = binary
+        self._cache = cache
         self._rpc: Optional[_Transport] = None
 
     def _rpc_transport(self) -> _Transport:
@@ -335,7 +384,8 @@ class NetworkDocumentService(DocumentService):
         token = (self._token_provider(self._tenant, self._doc)
                  if self._token_provider else None)
         return NetworkDeltaConnection(t, self._tenant, self._doc, details,
-                                      token=token, binary=self._binary)
+                                      token=token, binary=self._binary,
+                                      cache=self._cache)
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
         return NetworkDeltaStorage(self._rpc_transport(), self._tenant,
@@ -343,7 +393,8 @@ class NetworkDocumentService(DocumentService):
 
     def connect_to_storage(self) -> NetworkStorage:
         return NetworkStorage(self._rpc_transport(), self._tenant,
-                              self._doc, self._token_provider)
+                              self._doc, self._token_provider,
+                              cache=self._cache)
 
 
 class NetworkDocumentServiceFactory(DocumentServiceFactory):
@@ -352,14 +403,22 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
     routerlicious-driver tokens.ts TokenProvider)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 token_provider=None, binary: bool = True):
+                 token_provider=None, binary: bool = True,
+                 snapshot_cache: bool = True):
+        from .snapshot_cache import SnapshotCache
+
         self._host, self._port, self._timeout = host, port, timeout
         self._token_provider = token_provider
         self._binary = binary
+        # one cache shared by every document of this factory (the
+        # odspCache shape); reachable as factory.snapshot_cache for
+        # stats/assertions
+        self.snapshot_cache = SnapshotCache() if snapshot_cache else None
 
     def create_document_service(
         self, tenant_id: str, document_id: str
     ) -> NetworkDocumentService:
         return NetworkDocumentService(
             self._host, self._port, tenant_id, document_id, self._timeout,
-            token_provider=self._token_provider, binary=self._binary)
+            token_provider=self._token_provider, binary=self._binary,
+            cache=self.snapshot_cache)

@@ -19,13 +19,20 @@ class StorageConnection:
     """One shared transport to the storage process (many docs ride it)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
+        import threading
+
         self._host, self._port, self._timeout = host, port, timeout
         self._t: Optional[_Transport] = None
+        # front-end session threads and the orderer's ref-commit path
+        # race the lazy connect; without the lock the loser's socket +
+        # reader thread would leak
+        self._lock = threading.Lock()
 
     def transport(self) -> _Transport:
-        if self._t is None or self._t._closed:
-            self._t = _Transport(self._host, self._port, self._timeout)
-        return self._t
+        with self._lock:
+            if self._t is None or self._t._closed:
+                self._t = _Transport(self._host, self._port, self._timeout)
+            return self._t
 
     def request(self, frame: dict) -> dict:
         return self.transport().request(frame)

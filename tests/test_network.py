@@ -284,3 +284,47 @@ def test_unpackable_message_falls_back_to_json_broadcast(front_end):
         m.client_id == conn.client_id
         and m.reference_sequence_number == big_ref for m in got))
     conn.close()
+
+
+def test_snapshot_cache_second_boot_issues_no_storage_rpcs(front_end):
+    """The odsp-driver lesson (odspCache.ts): re-booting an unchanged
+    doc must serve version+tree from the driver cache — zero storage
+    round trips — and a committed summary invalidates the entry."""
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1 = loader.resolve("t", "cachedoc")
+    sm = SummaryManager(c1, max_ops=10**9)
+    s = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s.insert_text(0, "cache me")
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    sm.summarize_now()
+    assert wait_for(lambda: sm.summaries_acked == 1)
+
+    # first boot after the summary: fetches and populates the cache
+    c2 = loader.resolve("t", "cachedoc")
+    assert c2._base_snapshot is not None
+    assert c2.storage.rpcs > 0
+    assert wait_for(lambda: c2.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "cache me")
+
+    # second boot: served ENTIRELY from the cache
+    c3 = loader.resolve("t", "cachedoc")
+    assert c3._base_snapshot is not None
+    assert c3.storage.rpcs == 0, "cached boot issued storage RPCs"
+    assert wait_for(lambda: c3.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "cache me")
+
+    # a newer summary invalidates: the NEXT boot fetches the new head
+    s.insert_text(0, "fresh ")
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    sm.summarize_now()
+    assert wait_for(lambda: sm.summaries_acked == 2)
+    assert wait_for(
+        lambda: factory.snapshot_cache.stats["invalidations"] >= 1)
+    c4 = loader.resolve("t", "cachedoc")
+    assert c4.storage.rpcs > 0  # refetched the newer version
+    assert wait_for(lambda: c4.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "fresh cache me")
