@@ -173,7 +173,7 @@ def test_applier_stage_catches_up_and_survives_kill(tmp_path):
 
         def caught_up(target):
             return _applied_seq(state_dirs["applier"], "t", "doc") >= target
-        assert wait_for(lambda: caught_up(tail), timeout=60)
+        assert wait_for(lambda: caught_up(tail), timeout=120)
 
         os.kill(procs["applier"].pid, signal.SIGKILL)
         procs["applier"].wait(timeout=10)
@@ -184,4 +184,4 @@ def test_applier_stage_catches_up_and_survives_kill(tmp_path):
 
         procs["applier"] = _spawn_stage("applier", log_dir,
                                         state_dirs["applier"])
-        assert wait_for(lambda: caught_up(tail2), timeout=60)
+        assert wait_for(lambda: caught_up(tail2), timeout=120)
