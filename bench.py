@@ -109,26 +109,35 @@ def bench_service() -> dict:
     # were the dominant mid-trial latency source — disable the cycle
     # collector outright (service processes run the same posture) and
     # sweep between trials.
-    trials = []
-    for t in range(5):  # median of 5: bursty co-tenant CPU contention
-        gc.collect()      # can depress 2 trials in a row by ~2x
+    def trial(seed: int, array_lane: bool) -> dict:
+        gc.collect()      # contention can depress 2 trials in a row
         gc.freeze()
         gc.disable()
         applier = TpuDocumentApplier(
             max_docs=1024, max_slots=256, ops_per_dispatch=32,
             async_dispatch=True, min_wave_ops=32768)
         stats = run_inproc(n_docs=1024, clients_per_doc=2, ops_per_client=48,
-                           applier=applier, flush_every=4096, seed=1 + t,
-                           batch_size=24)
+                           applier=applier, flush_every=4096, seed=seed,
+                           batch_size=24, array_lane=array_lane)
         applier.close()
         gc.enable()
         gc.unfreeze()
         assert stats.applier_escalations == 0
         assert stats.ops_acked == stats.ops_submitted
         assert stats.applier_ops == stats.ops_submitted
-        trials.append(stats.summary())
+        return stats.summary()
+
+    # headline: the ARRAY LANE (the deli-tpu marshal, SURVEY §7 —
+    # boxcars ride the pipeline as int arrays; deli tickets with numpy;
+    # the applier bulk-loads device chunks; no per-op objects anywhere
+    # on the hot path). The dict lane rides along for comparison — the
+    # same pipeline fed per-op message objects.
+    trials = [trial(1 + t, True) for t in range(5)]
     trials.sort(key=lambda s: s["ops_per_sec"])
     headline = trials[len(trials) // 2]
+    dict_lane = sorted(trial(20 + t, False)["ops_per_sec"]
+                       for t in range(3))[1]
+    headline["ops_per_sec_dict_lane"] = dict_lane
 
     # the north star names 10k-doc scale: prove the number holds at 8192
     # concurrent docs (393k ops through the full path, same assertions)
@@ -147,7 +156,8 @@ def bench_service() -> dict:
             async_dispatch=True, min_wave_ops=196608)
         stats = run_inproc(n_docs=8192, clients_per_doc=2,
                            ops_per_client=24, applier=applier,
-                           flush_every=32768, seed=5 + t, batch_size=24)
+                           flush_every=32768, seed=5 + t, batch_size=24,
+                           array_lane=True)
         applier.close()
         gc.enable()
         gc.unfreeze()
@@ -357,7 +367,12 @@ def main() -> None:
                 "metric": "service_path_ops_per_sec",
                 "value": service["ops_per_sec"],
                 "unit": "ops/s",
+                # against the 50k NORTH STAR (BASELINE.json: the
+                # reference repo publishes no numbers of its own)
                 "vs_baseline": round(service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
+                # the same pipeline fed per-op message objects instead
+                # of the array-lane boxcars (deli-tpu marshal)
+                "ops_per_sec_dict_lane": service.get("ops_per_sec_dict_lane"),
                 # ack latency AT the headline load (submit → own
                 # broadcast, per boxcar): the north star's "p99 < 50 ms
                 # at >= 50k ops/s" measured on one path simultaneously

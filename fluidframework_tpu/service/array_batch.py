@@ -1,0 +1,198 @@
+"""Array-native boxcars: the deli-tpu marshal (SURVEY §7).
+
+Ref role: the reference's pipeline carries one JS object per op end to
+end (IBoxcarMessage of IDocumentMessages), which caps a Python port of
+the pipeline at dict-walk speed. This module is the TPU-first redesign
+the survey prescribes: a client's submitted boxcar of merge-tree text
+ops rides the pipeline as STRUCTURE-OF-ARRAYS — int32 fields + one
+concatenated text blob — so deli tickets it with numpy comparisons, the
+applier bulk-loads it into device staging without touching a per-op
+dict, and only COLD consumers (REST backfill, summarizer reads, legacy
+connections) materialize per-op message objects, lazily and cached.
+
+The array lane is an optimization, not a fork of semantics: an
+``ArrayBoxcar`` is exactly equivalent to a ``RawBoxcar`` of chanop
+``DocumentMessage``s (``to_raw_boxcar``), deli's array ticketing is
+fuzz-checked against the scalar lane, and a ``SequencedArrayBatch``
+materializes byte-identical ``SequencedDocumentMessage``s.
+
+Op kinds (matching the merge-tree wire ops, dds/sequence → chanop):
+
+- 0 insert:   a = pos;   text run in ``text[text_off[i]:text_off[i+1]]``
+- 1 remove:   a = start, b = end
+- 2 annotate: a = start, b = end, props in ``props[i]``
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+
+KIND_INSERT = 0
+KIND_REMOVE = 1
+KIND_ANNOTATE = 2
+
+
+@dataclass
+class ArrayBoxcar:
+    """One client's submitted boxcar of text chanops, SoA form.
+
+    All ops target ONE channel (``ds_id``/``channel_id``) — the shape
+    the synthetic load and text-heavy apps produce; anything else rides
+    the general dict boxcar."""
+
+    tenant_id: str
+    document_id: str
+    client_id: str
+    ds_id: str
+    channel_id: str
+    kind: np.ndarray      # int8 [n]
+    a: np.ndarray         # int32 [n] pos/start
+    b: np.ndarray         # int32 [n] end (removes/annotates)
+    cseq: np.ndarray      # int32 [n]
+    rseq: np.ndarray      # int32 [n]
+    text: str             # concatenated insert payloads
+    text_off: np.ndarray  # int32 [n+1] offsets into text (non-inserts 0-len)
+    props: Optional[list] = None  # per-op props dict or None (annotates)
+    timestamp: float = 0.0
+
+    @property
+    def n(self) -> int:
+        return len(self.kind)
+
+    def wire_op(self, i: int) -> dict:
+        k = int(self.kind[i])
+        if k == KIND_INSERT:
+            return {"type": 0, "pos": int(self.a[i]),
+                    "text": self.text[int(self.text_off[i]):
+                                      int(self.text_off[i + 1])]}
+        if k == KIND_REMOVE:
+            return {"type": 1, "start": int(self.a[i]), "end": int(self.b[i])}
+        return {"type": 2, "start": int(self.a[i]), "end": int(self.b[i]),
+                "props": dict(self.props[i]) if self.props else {}}
+
+    def contents(self, i: int) -> dict:
+        return {"kind": "chanop", "address": self.ds_id,
+                "contents": {"address": self.channel_id,
+                             "contents": self.wire_op(i)}}
+
+    def to_raw_boxcar(self):
+        """The exactly-equivalent dict boxcar (deli scalar fallback)."""
+        from .deli import RawBoxcar
+
+        ops = [
+            DocumentMessage(
+                client_sequence_number=int(self.cseq[i]),
+                reference_sequence_number=int(self.rseq[i]),
+                type=MessageType.OPERATION,
+                contents=self.contents(i))
+            for i in range(self.n)
+        ]
+        return RawBoxcar(tenant_id=self.tenant_id,
+                         document_id=self.document_id,
+                         client_id=self.client_id, ops=ops,
+                         timestamp=self.timestamp)
+
+
+@dataclass
+class SequencedArrayBatch:
+    """A ticketed ArrayBoxcar: seqs are ``base_seq + i``; per-op msns.
+
+    ``messages()`` materializes (and caches) the per-op
+    SequencedDocumentMessage list for cold consumers."""
+
+    boxcar: ArrayBoxcar
+    base_seq: int         # seq of op 0
+    msns: np.ndarray      # int64 [n]
+    timestamp: float
+    _materialized: Optional[list] = field(default=None, repr=False)
+
+    @property
+    def n(self) -> int:
+        return self.boxcar.n
+
+    @property
+    def last_seq(self) -> int:
+        return self.base_seq + self.n - 1
+
+    def message(self, i: int) -> SequencedDocumentMessage:
+        if self._materialized is not None:
+            return self._materialized[i]
+        box = self.boxcar
+        return SequencedDocumentMessage(
+            client_id=box.client_id,
+            sequence_number=self.base_seq + i,
+            minimum_sequence_number=int(self.msns[i]),
+            client_sequence_number=int(box.cseq[i]),
+            reference_sequence_number=int(box.rseq[i]),
+            type=MessageType.OPERATION,
+            contents=box.contents(i),
+            timestamp=self.timestamp,
+        )
+
+    def messages(self) -> list:
+        if self._materialized is None:
+            self._materialized = [self.message(i) for i in range(self.n)]
+        return self._materialized
+
+
+# ------------------------------------------------------- durable-log codec
+
+def _boxcar_to_dict(box: ArrayBoxcar) -> dict:
+    return {
+        "tenant_id": box.tenant_id, "document_id": box.document_id,
+        "client_id": box.client_id, "ds": box.ds_id, "ch": box.channel_id,
+        "kind": box.kind.tolist(), "a": box.a.tolist(), "b": box.b.tolist(),
+        "cseq": box.cseq.tolist(), "rseq": box.rseq.tolist(),
+        "text": box.text, "text_off": box.text_off.tolist(),
+        "props": box.props, "timestamp": box.timestamp,
+    }
+
+
+def _boxcar_from_dict(d: dict) -> ArrayBoxcar:
+    return ArrayBoxcar(
+        tenant_id=d["tenant_id"], document_id=d["document_id"],
+        client_id=d["client_id"], ds_id=d["ds"], channel_id=d["ch"],
+        kind=np.asarray(d["kind"], np.int8),
+        a=np.asarray(d["a"], np.int32), b=np.asarray(d["b"], np.int32),
+        cseq=np.asarray(d["cseq"], np.int32),
+        rseq=np.asarray(d["rseq"], np.int32),
+        text=d["text"], text_off=np.asarray(d["text_off"], np.int32),
+        props=d.get("props"), timestamp=d["timestamp"],
+    )
+
+
+def _abatch_to_dict(batch: SequencedArrayBatch) -> dict:
+    return {
+        "boxcar": _boxcar_to_dict(batch.boxcar),
+        "base_seq": batch.base_seq,
+        "msns": batch.msns.tolist(),
+        "timestamp": batch.timestamp,
+    }
+
+
+def _abatch_from_dict(d: dict) -> SequencedArrayBatch:
+    return SequencedArrayBatch(
+        boxcar=_boxcar_from_dict(d["boxcar"]), base_seq=d["base_seq"],
+        msns=np.asarray(d["msns"], np.int64), timestamp=d["timestamp"],
+    )
+
+
+def _register_codecs() -> None:
+    from ..protocol.serialization import register_message_type
+
+    register_message_type("abox", ArrayBoxcar, _boxcar_to_dict,
+                          _boxcar_from_dict)
+    register_message_type("abatch", SequencedArrayBatch, _abatch_to_dict,
+                          _abatch_from_dict)
+
+
+_register_codecs()

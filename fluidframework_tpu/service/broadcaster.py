@@ -49,8 +49,12 @@ class BroadcasterLambda:
         return f"{tenant_id}/{document_id}"
 
     def handler(self, message: QueuedMessage) -> None:
-        envelope = message.value  # {"tenant_id", "document_id", "message"|"boxcar"}
-        batch = envelope.get("boxcar")
+        envelope = message.value  # {..., "message"|"boxcar"|"abatch"}
+        batch = envelope.get("abatch")  # array lane: published AS-IS —
+        # array-aware subscribers consume it raw, legacy ones receive
+        # its lazily-materialized messages (local_server._deliver_ops)
+        if batch is None:
+            batch = envelope.get("boxcar")
         if batch is None:
             batch = [envelope["message"]]
         self._pubsub.publish(

@@ -78,6 +78,11 @@ def wire_applier(server: LocalServer, applier, tenant: str, docs: list[str]):
 
     def make_cb(doc):
         def cb(batch):
+            if type(batch) is not list:  # array lane: bulk ingest
+                box = batch.boxcar
+                if box.ds_id == DS_ID and box.channel_id == CHANNEL_ID:
+                    applier.ingest_array_batch(tenant, doc, batch)
+                return
             pairs = []
             for msg in batch:
                 if msg.type is not op_t:
@@ -109,6 +114,7 @@ def run_inproc(
     flush_every: int = 256,
     tenant: str = "bench",
     batch_size: int = 1,
+    array_lane: bool = False,
 ) -> LoadStats:
     """Drive the full in-process pipeline at max rate; measure throughput.
 
@@ -119,6 +125,12 @@ def run_inproc(
     ``batch_size``: ops each client submits per round as one boxcar (the
     outbound DeltaQueue flush / Kafka boxcar analog). ``ops_per_client``
     must be a multiple of it.
+
+    ``array_lane``: submit ArrayBoxcars (the deli-tpu marshal,
+    service/array_batch.py) — deli tickets with numpy, the applier
+    bulk-loads chunks, subscribers consume batches without per-op
+    message objects. Semantically equivalent to the dict lane
+    (tests/test_array_lane.py pins the equivalence).
     """
     rng = random.Random(seed)
     server = LocalServer()
@@ -152,6 +164,18 @@ def run_inproc(
                         (time.perf_counter() - submit_t[0]) * 1e3)
                 stats.ops_acked += acked
             conn.on_ops = on_ops
+            if array_lane:
+                # message LISTS (joins etc.) still route to on_ops above;
+                # only SequencedArrayBatch objects land here
+                def on_abatch(batch, editor=editor, me=conn.client_id):
+                    if batch.boxcar.client_id == me:
+                        editor.ref_seq = batch.last_seq
+                        stats.ack_latencies_ms.append(
+                            (time.perf_counter() - submit_t[0]) * 1e3)
+                        stats.ops_acked += batch.n
+                    else:
+                        editor.observe_abatch(batch)
+                conn.on_abatch = on_abatch
             sessions.append((conn, editor))
 
     assert ops_per_client % batch_size == 0
@@ -162,7 +186,11 @@ def run_inproc(
     for i in range(rounds):
         for conn, editor in sessions:
             submit_t[0] = time.perf_counter()
-            conn.submit(editor.next_ops(batch_size))
+            if array_lane:
+                conn.submit_array(editor.next_boxcar(
+                    batch_size, tenant, conn.document_id, conn.client_id))
+            else:
+                conn.submit(editor.next_ops(batch_size))
             stats.ops_submitted += batch_size
             since_flush += batch_size
             if applier is not None and since_flush >= flush_every:

@@ -250,17 +250,26 @@ class LocalOrderer:
             },
         )
 
-    def _on_sequenced_batch(self, msgs: list[SequencedDocumentMessage]) -> None:
+    def _on_sequenced_batch(self, msgs) -> None:
         """A ticketed boxcar rides the deltas topic as one record, so the
-        downstream stages (scriptorium/scribe/broadcaster) batch too."""
-        self._log.append(
-            self.deltas_topic,
-            {
+        downstream stages (scriptorium/scribe/broadcaster) batch too.
+        The array lane hands a SequencedArrayBatch (no per-op objects);
+        the dict lane a list of SequencedDocumentMessage."""
+        from .array_batch import SequencedArrayBatch
+
+        if type(msgs) is SequencedArrayBatch:
+            record = {
+                "tenant_id": self.tenant_id,
+                "document_id": self.document_id,
+                "abatch": msgs,
+            }
+        else:
+            record = {
                 "tenant_id": self.tenant_id,
                 "document_id": self.document_id,
                 "boxcar": msgs,
-            },
-        )
+            }
+        self._log.append(self.deltas_topic, record)
 
     def _on_nack(self, client_id: str, nack: Nack) -> None:
         self._pubsub.publish(f"nack/{self.tenant_id}/{self.document_id}/{client_id}", nack)

@@ -53,7 +53,8 @@ class ServerConnection:
         self.client_id = client_id
         self.details = details
         self._handlers: dict[str, Optional[Callable]] = {
-            "op": None, "ops": None, "nack": None, "signal": None}
+            "op": None, "ops": None, "abatch": None, "nack": None,
+            "signal": None}
         # op events buffer as batches; nack/signal as single events
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
         self.connected = True
@@ -67,7 +68,13 @@ class ServerConnection:
         else:
             cb(event)
 
-    def _deliver_ops(self, batch: list) -> None:
+    def _deliver_ops(self, batch) -> None:
+        if type(batch) is not list:  # array lane: SequencedArrayBatch
+            cb = self._handlers["abatch"]
+            if cb is not None:  # array-aware consumer: no materialization
+                cb(batch)
+                return
+            batch = batch.messages()  # legacy consumer: cold materialize
         cb = self._handlers["ops"]
         if cb is not None:
             cb(batch)
@@ -83,7 +90,10 @@ class ServerConnection:
         self._handlers[kind] = cb
         if cb is None:
             return
-        if kind in ("op", "ops"):
+        if kind in ("op", "ops", "abatch"):
+            # op events (message lists AND array batches) share one
+            # buffer; re-dispatch through _deliver_ops so each entry
+            # reaches the best now-attached handler
             pending, self._buffers["op"] = self._buffers["op"], []
             for batch in pending:
                 self._deliver_ops(batch)
@@ -98,6 +108,11 @@ class ServerConnection:
     on_ops = property(
         lambda self: self._handlers["ops"],
         lambda self, cb: self._set_handler("ops", cb))
+    # array-aware consumers get the SequencedArrayBatch raw (the deli-tpu
+    # marshal lane); others transparently receive materialized messages
+    on_abatch = property(
+        lambda self: self._handlers["abatch"],
+        lambda self, cb: self._set_handler("abatch", cb))
     on_nack = property(
         lambda self: self._handlers["nack"],
         lambda self, cb: self._set_handler("nack", cb))
@@ -109,6 +124,13 @@ class ServerConnection:
         if not self.connected:
             raise RuntimeError("connection closed")
         self.server._submit(self, messages)
+
+    def submit_array(self, boxcar) -> None:
+        """Submit an ArrayBoxcar (service/array_batch.py) — the SoA
+        boxcar deli tickets without building per-op objects."""
+        if not self.connected:
+            raise RuntimeError("connection closed")
+        self.server._submit_array(self, boxcar)
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         if not self.connected:
@@ -370,6 +392,25 @@ class LocalServer:
                 timestamp=now,
             )
         )
+        self._maybe_drain()
+
+    def _submit_array(self, conn: ServerConnection, boxcar) -> None:
+        if not getattr(conn, "can_write", True):
+            from ..protocol.messages import Nack, NackErrorType
+
+            self.pubsub.publish(
+                f"nack/{conn.tenant_id}/{conn.document_id}/"
+                f"{conn.client_id}",
+                Nack(operation=None, sequence_number=-1, code=403,
+                     type=NackErrorType.INVALID_SCOPE,
+                     message="token lacks doc:write scope"))
+            return
+        boxcar.tenant_id = conn.tenant_id
+        boxcar.document_id = conn.document_id
+        boxcar.client_id = conn.client_id
+        boxcar.timestamp = self._clock()
+        orderer = self._get_orderer(conn.tenant_id, conn.document_id)
+        orderer.order(boxcar)
         self._maybe_drain()
 
     def _signal(self, conn: ServerConnection, signal: Signal) -> None:

@@ -67,6 +67,12 @@ class ScribeLambda:
         if message.offset <= self.last_offset:
             return  # replay after restart
         self.last_offset = message.offset
+        abatch = message.value.get("abatch")
+        if abatch is not None:
+            # array-lane run: plain operations by construction
+            self.protocol.observe_operation_run(
+                abatch.base_seq, abatch.last_seq, int(abatch.msns[-1]))
+            return
         batch = message.value.get("boxcar")
         if batch is not None:
             # boxcars are plain-operation runs by construction (the deli

@@ -59,12 +59,23 @@ class ScriptoriumLambda:
     def handler(self, message: QueuedMessage) -> None:
         envelope = message.value
         name = self.collection(envelope["tenant_id"], envelope["document_id"])
+        doc = self._doc(name)
+        log = doc["messages"]
+        # dense invariant: log[i] holds seq base+i+1, so the last stored
+        # seq is positional (entries may be per-op messages OR a shared
+        # SequencedArrayBatch object occupying its n positions)
+        last = doc.get("base", 0) + len(log)
+        abatch = envelope.get("abatch")
+        if abatch is not None:
+            first, n = abatch.base_seq, abatch.n
+            if first == last + 1:  # hot path: ONE list-repeat, no per-op
+                log.extend([abatch] * n)
+            elif first + n - 1 > last:
+                log.extend([abatch] * (first + n - 1 - last))
+            return
         batch = envelope.get("boxcar")
         if batch is None:
             batch = [envelope["message"]]
-        doc = self._doc(name)
-        log = doc["messages"]
-        last = log[-1].sequence_number if log else doc.get("base", 0)
         first = batch[0].sequence_number
         if first == last + 1:  # the hot path: append in arrival order
             log.extend(batch)
@@ -117,4 +128,14 @@ class ScriptoriumLambda:
         log = doc["messages"]
         lo = max(from_seq - base, 0)
         hi = min(to_seq - 1 - base, len(log))
-        return log[lo:hi] if hi > lo else []
+        if hi <= lo:
+            return []
+        out = []
+        for i in range(lo, hi):
+            entry = log[i]
+            if isinstance(entry, SequencedDocumentMessage):
+                out.append(entry)
+            else:  # a SequencedArrayBatch occupying its seq positions:
+                # materialize the one op this position holds (cold path)
+                out.append(entry.message(base + i + 1 - entry.base_seq))
+        return out

@@ -99,3 +99,75 @@ class SyntheticEditor:
 
     def next_op(self) -> DocumentMessage:
         return self.next_ops(1)[0]
+
+    def next_boxcar(self, count: int, tenant: str = "", doc: str = "",
+                    client_id: str = ""):
+        """Generate a submission batch as an ArrayBoxcar (the deli-tpu
+        marshal lane): int arrays + one text blob, no per-op dicts. Same
+        op mix and length-tracking contract as :meth:`next_ops`."""
+        import numpy as np
+
+        from .array_batch import ArrayBoxcar
+
+        # build in python lists (numpy scalar writes cost ~5× a list
+        # append), ONE array conversion per field at the end
+        kind: list[int] = []
+        a: list[int] = []
+        b: list[int] = []
+        text_off: list[int] = [0]
+        texts: list[str] = []
+        props = None
+        rnd = self.rng.random
+        rm, ann, mi = (self.remove_fraction, self.annotate_fraction,
+                       self.max_insert)
+        length = self.length
+        off = 0
+        for i in range(count):
+            r = rnd()
+            if length > 4 and r < rm:
+                x = int(rnd() * (length - 1))
+                y = x + 1 + int(rnd() * min(length - x - 1, mi - 1))
+                kind.append(1)
+                a.append(x)
+                b.append(y)
+                length -= y - x
+            elif length > 1 and r < rm + ann:
+                x = int(rnd() * (length - 1))
+                y = x + 1 + int(rnd() * min(length - x - 1, mi - 1))
+                kind.append(2)
+                a.append(x)
+                b.append(y)
+                if props is None:
+                    props = [None] * count
+                props[i] = {"k": int(rnd() * 4)}
+            else:
+                n = 1 + int(rnd() * mi)
+                o = int(rnd() * 8)
+                kind.append(0)
+                a.append(int(rnd() * (length + 1)))
+                b.append(0)
+                texts.append(_TEXT_POOL[o:o + n])
+                off += n
+                length += n
+            text_off.append(off)
+        base = self.client_seq
+        self.client_seq = base + count
+        self.length = length
+        return ArrayBoxcar(
+            tenant_id=tenant, document_id=doc, client_id=client_id,
+            ds_id=DS_ID, channel_id=CHANNEL_ID,
+            kind=np.asarray(kind, np.int8),
+            a=np.asarray(a, np.int32), b=np.asarray(b, np.int32),
+            cseq=np.arange(base + 1, base + count + 1, dtype=np.int32),
+            rseq=np.full(count, self.ref_seq, np.int32),
+            text="".join(texts),
+            text_off=np.asarray(text_off, np.int32), props=props)
+
+    def observe_abatch(self, batch) -> None:
+        """Track another client's sequenced array batch (vectorized
+        length deltas — the array-lane analog of :meth:`observe`)."""
+        self.ref_seq = batch.last_seq
+        box = batch.boxcar
+        ins = int(box.text_off[-1])
+        rem = int(((box.b - box.a) * (box.kind == 1)).sum())
+        self.length = max(0, self.length + ins - rem)

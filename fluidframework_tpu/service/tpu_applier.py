@@ -232,7 +232,12 @@ class TpuDocumentApplier:
         self._client_ids: dict[int, dict[str, int]] = {}
         # staged device ops as 12-tuples in ops/apply field order; one
         # np.array() per slot per flush instead of one per op
-        self._staged: dict[int, list[tuple]] = {}
+        # staged device ops per slot, as a list of int32 [n, OP_FIELDS]
+        # CHUNKS (one per ingested batch — the array lane appends its
+        # vectorized rows directly; the dict lane converts its tuple
+        # batch once); _staged_ops tracks the total row count
+        self._staged: dict[int, list] = {}
+        self._staged_ops = 0
         self._host_docs: dict[int, MergeTreeClient] = {}  # escalated docs
         self._doc_keys: dict[int, tuple[str, str]] = {}
         self._mesh = mesh
@@ -359,14 +364,10 @@ class TpuDocumentApplier:
             for msg, wire_op in pairs:
                 self._apply_host(slot, msg, wire_op)
             return
-        if self._async:
-            # stage into a local list, then splice under the lock — keeps
-            # the critical section to one append/extend
-            staged = []
-        else:
-            staged = self._staged.get(slot)
-            if staged is None:
-                staged = self._staged[slot] = []
+        # stage into a local tuple list; one np conversion per batch at
+        # the end (the chunk) — per-op tuple appends beat per-op numpy
+        # row writes, and the wave builder concatenates chunks
+        staged = []
         table = self._client_ids.setdefault(slot, {})
         arena = self.arenas[slot]
         # hot-loop locals: plain inserts/removes (the overwhelming bulk of
@@ -413,13 +414,91 @@ class TpuDocumentApplier:
                 for msg2, wire_op2 in pairs[i + 1:]:
                     self._apply_host(slot, msg2, wire_op2)
                 return
-        if self._async and staged:
+        if staged:
+            self._push_chunk(slot, np.asarray(staged, np.int32))
+
+    def ingest_array_batch(self, tenant_id: str, document_id: str,
+                           batch) -> None:
+        """Stage a SequencedArrayBatch (service/array_batch.py) as ONE
+        vectorized chunk — the deli-tpu marshal's device on-ramp: no
+        per-op dicts, tuples, or message objects. Inserts land in the
+        arena as a single concatenated append; annotate rows (the rare
+        kind) fill their key/val ids in a small loop over just the
+        annotate indices."""
+        slot = self.slot_of(tenant_id, document_id)
+        box = batch.boxcar
+        n = box.n
+        if n == 0:
+            return
+        self._applied_seq[slot] = max(self._applied_seq.get(slot, 0),
+                                      batch.last_seq)
+        self._first_seq.setdefault(slot, batch.base_seq)
+        if slot in self._restore_applied:
+            self._post_restore_first.setdefault(slot, batch.base_seq)
+        if slot in self._host_docs:
+            for i in range(n):
+                self._apply_host(slot, batch.message(i), box.wire_op(i))
+            return
+        table = self._client_ids.setdefault(slot, {})
+        client = table.get(box.client_id)
+        if client is None:
+            client = len(table)
+            table[box.client_id] = client
+        kind = box.kind
+        is_ann = kind == 2  # wire kind 2 = annotate (array_batch.py)
+        ann_idx = np.nonzero(is_ann)[0] if is_ann.any() else ()
+        # annotates expand to one row PER PROP KEY; with single-key props
+        # (the overwhelming case) the chunk stays one row per op; empty
+        # or multi-key props take the materialized slow path
+        if len(ann_idx) and (
+                box.props is None
+                or any(len(box.props[int(i)] or {}) != 1 for i in ann_idx)):
+            pairs = [(batch.message(i), box.wire_op(i)) for i in range(n)]
+            self.ingest_batch(tenant_id, document_id, pairs)
+            return
+        chunk = np.zeros((n, OP_FIELDS), np.int32)
+        # wire kinds (0 ins, 1 rem, 2 ann) → device op codes (1, 2, 3)
+        chunk[:, F_TYPE] = kind.astype(np.int32) + 1
+        chunk[:, F_POS] = box.a
+        chunk[:, F_END] = box.b
+        seqs = batch.base_seq + np.arange(n, dtype=np.int64)
+        chunk[:, F_SEQ] = seqs
+        chunk[:, F_REFSEQ] = box.rseq
+        chunk[:, F_CLIENT] = client
+        chunk[:, F_MSN] = batch.msns
+        arena_start = self.arenas[slot].append(box.text)
+        chunk[:, F_TLEN] = np.diff(box.text_off)
+        chunk[:, F_TSTART] = arena_start + box.text_off[:-1]
+        for i in ann_idx:
+            (k, v), = box.props[int(i)].items()
+            chunk[i, F_KEY] = self.prop_table.intern_key(k)
+            chunk[i, F_VAL] = (NO_VAL if v is None
+                               else self.prop_table.intern_val(v))
+        self._push_chunk(slot, chunk)
+
+    def _push_chunk(self, slot: int, chunk: np.ndarray) -> None:
+        """Append a staged [n, OP_FIELDS] chunk (the ONLY staging-count
+        mutation point besides _take_wave_locked/_drop_staged)."""
+        if self._async:
             with self._lock:
-                cur = self._staged.get(slot)
-                if cur is None:
-                    self._staged[slot] = staged
-                else:
-                    cur.extend(staged)
+                self._staged.setdefault(slot, []).append(chunk)
+                self._staged_ops += len(chunk)
+        else:
+            self._staged.setdefault(slot, []).append(chunk)
+            self._staged_ops += len(chunk)
+
+    def _drop_staged(self, slot: int) -> None:
+        """Discard a slot's staged chunks (escalation path), keeping the
+        staged-op count consistent."""
+        if self._async:
+            with self._lock:
+                dropped = self._staged.pop(slot, None)
+                if dropped:
+                    self._staged_ops -= sum(len(c) for c in dropped)
+        else:
+            dropped = self._staged.pop(slot, None)
+            if dropped:
+                self._staged_ops -= sum(len(c) for c in dropped)
 
     def _stage_op(self, staged, arena, w, seq, ref, client, msn) -> bool:
         """Append a wire op's device tuples (ops/apply field order).
@@ -500,9 +579,13 @@ class TpuDocumentApplier:
             else:
                 batch = np.zeros(
                     (self.max_docs, self.K, OP_FIELDS), np.int32)
-                for slot, ops in parts:
-                    batch[slot, :len(ops)] = np.array(ops, np.int32)
-                    total += len(ops)
+                for slot, chunks, count in parts:
+                    if count == 0:
+                        continue
+                    rows = (chunks[0] if len(chunks) == 1
+                            else np.concatenate(chunks))
+                    batch[slot, :count] = rows
+                    total += count
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 ops_dev = jax.device_put(
@@ -518,19 +601,37 @@ class TpuDocumentApplier:
     # ------------------------------------------------------ async worker
 
     def _take_wave_locked(self):
-        """Pop up to K staged ops per doc (caller holds the lock)."""
+        """Pop up to K staged op ROWS per doc (caller holds the lock).
+
+        Returns [(slot, chunk_list, row_count)]; an overflowing chunk is
+        split by an array view, so staging order is preserved."""
         if not self._staged:
             return None
         parts = []
         drained = []
         K = self.K
-        for slot, ops in self._staged.items():
-            if len(ops) <= K:
-                parts.append((slot, ops))
+        for slot, chunks in self._staged.items():
+            take, rest, count = [], None, 0
+            for ci, ch in enumerate(chunks):
+                n = len(ch)
+                if count + n <= K:
+                    take.append(ch)
+                    count += n
+                else:
+                    room = K - count
+                    if room > 0:
+                        take.append(ch[:room])
+                        count = K
+                        rest = [ch[room:]] + chunks[ci + 1:]
+                    else:
+                        rest = chunks[ci:]
+                    break
+            parts.append((slot, take, count))
+            self._staged_ops -= count
+            if rest is None:
                 drained.append(slot)
             else:
-                parts.append((slot, ops[:K]))
-                self._staged[slot] = ops[K:]
+                self._staged[slot] = rest
         for slot in drained:
             del self._staged[slot]
         return parts
@@ -544,20 +645,21 @@ class TpuDocumentApplier:
         list (per-doc conversions were the dominant host cost at high doc
         counts). ``_take_wave_locked`` caps each doc at K ops, so a wave
         always fits."""
-        rows: list[tuple] = []
+        all_chunks: list = []
         slots: list[int] = []
         lens: list[int] = []
-        for slot, ops in parts:
-            if not ops:  # interval-only batches stage nothing
+        for slot, chunks, count in parts:
+            if count == 0:  # interval-only batches stage nothing
                 continue
-            rows.extend(ops)
+            all_chunks.extend(chunks)
             slots.append(slot)
-            lens.append(len(ops))
-        n = len(rows)
-        if n == 0:
+            lens.append(count)
+        if not all_chunks:
             return 0
         K = self.K
-        flat = np.array(rows, np.int32)
+        flat = (all_chunks[0] if len(all_chunks) == 1
+                else np.concatenate(all_chunks))
+        n = len(flat)
         lens_a = np.array(lens)
         starts = np.cumsum(lens_a) - lens_a
         slots_a = np.array(slots, np.int64)
@@ -625,9 +727,8 @@ class TpuDocumentApplier:
             if self._stop:
                 return
             with self._lock:
-                if not self._draining and self._min_wave and sum(
-                    len(v) for v in self._staged.values()
-                ) < self._min_wave:
+                if not self._draining and self._min_wave \
+                        and self._staged_ops < self._min_wave:
                     parts = None
                 else:
                     parts = self._take_wave_locked()
@@ -821,11 +922,7 @@ class TpuDocumentApplier:
         self.host_escalations += 1
         replica = MergeTreeClient(f"tpu-applier/{tenant_id}/{document_id}")
         self._host_docs[slot] = replica
-        if self._async:
-            with self._lock:
-                self._staged.pop(slot, None)
-        else:
-            self._staged.pop(slot, None)
+        self._drop_staged(slot)
         for m in self._replay_log(tenant_id, document_id):
             if m.type == MessageType.OPERATION:
                 replica.apply_msg(m, local=False)
