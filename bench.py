@@ -139,6 +139,32 @@ def bench_service() -> dict:
                        for t in range(3))[1]
     headline["ops_per_sec_dict_lane"] = dict_lane
 
+    # the same pipeline over the DURABLE C++ op log (the split-service
+    # core's posture: every raw/delta record encoded + written to disk)
+    import tempfile
+
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    def durable_trial(seed: int) -> float:
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        applier = TpuDocumentApplier(
+            max_docs=1024, max_slots=256, ops_per_dispatch=32,
+            async_dispatch=True, min_wave_ops=32768)
+        stats = run_inproc(n_docs=1024, clients_per_doc=2,
+                           ops_per_client=48, applier=applier,
+                           flush_every=4096, seed=seed, batch_size=24,
+                           array_lane=True,
+                           log=DurableLog(tempfile.mkdtemp()))
+        applier.close()
+        gc.enable()
+        gc.unfreeze()
+        assert stats.ops_acked == stats.ops_submitted
+        return stats.ops_per_sec
+    headline["ops_per_sec_durable_log"] = round(
+        sorted(durable_trial(40 + t) for t in range(3))[1], 1)
+
     # the north star names 10k-doc scale: prove the number holds at 8192
     # concurrent docs (393k ops through the full path, same assertions)
     warm8k = TpuDocumentApplier(max_docs=8192, max_slots=256,
@@ -373,6 +399,9 @@ def main() -> None:
                 # the same pipeline fed per-op message objects instead
                 # of the array-lane boxcars (deli-tpu marshal)
                 "ops_per_sec_dict_lane": service.get("ops_per_sec_dict_lane"),
+                # and over the durable C++ op log (split-core posture)
+                "ops_per_sec_durable_log": service.get(
+                    "ops_per_sec_durable_log"),
                 # ack latency AT the headline load (submit → own
                 # broadcast, per boxcar): the north star's "p99 < 50 ms
                 # at >= 50k ops/s" measured on one path simultaneously

@@ -108,6 +108,13 @@ class DurableLog(OrderedLogBase):
         super().__init__()
         self.directory = directory
         self._log = NativeOpLog(directory, readonly=readonly)
+        # last-record decode cache per topic, PRIMED at append: the
+        # drain delivers each record to every subscriber back to back
+        # (3× on the deltas topic), and in-process those deliveries
+        # share the live object exactly like LocalLog — consumers treat
+        # log records as immutable. Cuts per-record JSON decodes from
+        # k-subscribers to zero on the hot path.
+        self._read_cache: dict[str, tuple] = {}
 
     def poll(self) -> bool:
         """Refresh every subscribed topic from disk; mark grown topics
@@ -145,10 +152,17 @@ class DurableLog(OrderedLogBase):
         self._log.flush()
 
     def _store(self, topic: str, value: Any) -> int:
-        return self._log.append(_sanitize(topic), _encode_value(value))
+        offset = self._log.append(_sanitize(topic), _encode_value(value))
+        self._read_cache[topic] = (offset, value)
+        return offset
 
     def _load(self, topic: str, offset: int) -> Any:
-        return _decode_value(self._log.read(_sanitize(topic), offset))
+        cached = self._read_cache.get(topic)
+        if cached is not None and cached[0] == offset:
+            return cached[1]
+        value = _decode_value(self._log.read(_sanitize(topic), offset))
+        self._read_cache[topic] = (offset, value)
+        return value
 
     def _stored_length(self, topic: str) -> int:
         return self._log.length(_sanitize(topic))

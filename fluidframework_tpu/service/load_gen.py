@@ -115,6 +115,7 @@ def run_inproc(
     tenant: str = "bench",
     batch_size: int = 1,
     array_lane: bool = False,
+    log=None,
 ) -> LoadStats:
     """Drive the full in-process pipeline at max rate; measure throughput.
 
@@ -133,7 +134,7 @@ def run_inproc(
     (tests/test_array_lane.py pins the equivalence).
     """
     rng = random.Random(seed)
-    server = LocalServer()
+    server = LocalServer(log=log)
     docs = [f"doc{i}" for i in range(n_docs)]
     stats = LoadStats()
 

@@ -220,6 +220,11 @@ class ApplierStage(_StageHostBase):
         def on_deltas(message, tenant=tenant, doc=doc, topic=topic):
             self._offsets[topic] = message.offset
             value = message.value
+            abatch = value.get("abatch")
+            if abatch is not None:
+                if abatch.last_seq > self.applier.applied_seq(tenant, doc):
+                    self.applier.ingest_array_batch(tenant, doc, abatch)
+                return
             batch = value.get("boxcar")
             msgs = batch if batch is not None else [value["message"]]
             # replay idempotency: the farm checkpoint is saved BEFORE
