@@ -58,18 +58,42 @@ def _doc_of(topic: str) -> tuple[str, str]:
     return tenant, doc
 
 
+def doc_partition(tenant: str, doc: str, n_partitions: int) -> int:
+    """Stable doc → partition map (ref: the Kafka partition-by-docId
+    routing, lambdas-driver document-router). md5, NOT hash(): python
+    randomizes hash() per process, and every stage process must agree."""
+    import hashlib
+
+    digest = hashlib.md5(f"{tenant}/{doc}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % n_partitions
+
+
 class _StageHostBase:
     """Discovery + poll/drain/checkpoint loop shared by the stages."""
 
     #: deltas topics are the stage input; uploads only matter to scribe
     topic_prefixes = ("deltas/",)
 
-    def __init__(self, log_dir: str, state_dir: str):
+    def __init__(self, log_dir: str, state_dir: str,
+                 partition: Optional[tuple] = None):
         self.shared = DurableLog(log_dir, readonly=True)
         self.state = DurableLog(state_dir)
+        # (k, n): this process owns docs with doc_partition(...) == k —
+        # N stage processes split the doc space; a redeploy with a
+        # different split MOVES docs between processes (the new owner
+        # resumes from its checkpoints, or replays from 0 for a doc it
+        # never owned)
+        self.partition = partition
         self._known: set[str] = set()
         self._last_checkpoint = time.monotonic()
         self.checkpoint_every_s = 1.0
+
+    def _owns(self, topic: str) -> bool:
+        if self.partition is None:
+            return True
+        tenant, doc = _doc_of(topic)
+        k, n = self.partition
+        return doc_partition(tenant, doc, n) == k
 
     # ------------------------------------------------------------- plumbing
 
@@ -92,7 +116,8 @@ class _StageHostBase:
             for topic in self.shared.list_topics(prefix):
                 if topic not in self._known:
                     self._known.add(topic)
-                    self.attach(topic)
+                    if self._owns(topic):
+                        self.attach(topic)
 
     def run_forever(self) -> None:
         print("READY", flush=True)
@@ -132,8 +157,9 @@ class ScribeStage(_StageHostBase):
     # sees a summarize whose upload record it hasn't ingested yet
     topic_prefixes = ("uploads/", "deltas/")
 
-    def __init__(self, log_dir: str, state_dir: str):
-        super().__init__(log_dir, state_dir)
+    def __init__(self, log_dir: str, state_dir: str,
+                 partition=None):
+        super().__init__(log_dir, state_dir, partition=partition)
         self.db = InMemoryDb()
         self.scribes: dict[str, object] = {}  # "tenant/doc" → ScribeLambda
 
@@ -197,8 +223,9 @@ class ApplierStage(_StageHostBase):
 
     def __init__(self, log_dir: str, state_dir: str,
                  max_docs: int = 64, max_slots: int = 256,
-                 ds_id: str = "default", channel_id: str = "text"):
-        super().__init__(log_dir, state_dir)
+                 ds_id: str = "default", channel_id: str = "text",
+                 partition=None):
+        super().__init__(log_dir, state_dir, partition=partition)
         from .tpu_applier import TpuDocumentApplier, load_applier_checkpoint
 
         self.ds_id, self.channel_id = ds_id, channel_id
@@ -275,9 +302,17 @@ def main() -> None:
                         help="the core's durable log directory (read-only)")
     parser.add_argument("--state-dir", required=True,
                         help="this stage's own writable log directory")
+    parser.add_argument("--partition", default=None, metavar="K/N",
+                        help="own only docs with doc_partition == K of N "
+                             "(N stage processes split the doc space)")
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
-    STAGES[args.stage](args.log_dir, args.state_dir).run_forever()
+    partition = None
+    if args.partition:
+        k, _, n = args.partition.partition("/")
+        partition = (int(k), int(n))
+    STAGES[args.stage](args.log_dir, args.state_dir,
+                       partition=partition).run_forever()
 
 
 if __name__ == "__main__":
