@@ -344,14 +344,21 @@ def bench_network() -> dict:
                     best = r  # even the lightest load misses: report it
                 break
         # confirm the knee: median p99 of 5 runs (bursty co-tenant CPU
-        # can depress two consecutive trials)
+        # can depress two consecutive trials). If even the confirm
+        # median misses the target, step DOWN a rung and re-confirm —
+        # reporting a "knee" whose own confirmation failed would
+        # overclaim the sustainable load.
         knee_rate = best["rate_hz"]
-        confirms = sorted(
-            (run_workers(knee_ports, 4, 64, 2, knee_rate, 32,
-                         max(8, int(8 * knee_rate)), f"c{t}r")
-             for t in range(5)),
-            key=lambda r: r["p99_ack_ms"])
-        best = confirms[2]
+        while True:
+            confirms = sorted(
+                (run_workers(knee_ports, 4, 64, 2, knee_rate, 32,
+                             max(8, int(8 * knee_rate)), f"c{knee_rate}{t}")
+                 for t in range(5)),
+                key=lambda r: r["p99_ack_ms"])
+            best = confirms[2]
+            if best["p99_ack_ms"] < 50.0 or knee_rate <= 1.0:
+                break
+            knee_rate = round(knee_rate - 0.25, 2)
 
         # ---- the same geometry terminating directly at the core ----
         direct = run_workers([port], 4, 64, 2, knee_rate, 32,
