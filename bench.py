@@ -423,8 +423,10 @@ def main() -> None:
                 # the same full path at 8192 concurrent docs (scale proof)
                 "ops_per_sec_8k_docs": service.get("ops_per_sec_8k_docs"),
                 # at-load socket knee (256 docs × 2 clients, binary wire,
-                # 32-op boxcars, 2-gateway production topology): highest
-                # swept load with p99 < 50 ms
+                # 32-op boxcars, 2-gateway production topology): the
+                # highest rate whose median-of-5 confirmation holds
+                # p99 < 50 ms (stepped down from the sweep if needed; at
+                # the floor the published p99 marks a miss)
                 "net_max_load_ops_per_sec": net["knee"]["ops_per_sec"],
                 "net_p50_ack_ms": net["knee"]["p50_ack_ms"],
                 "net_p99_ack_ms": net["knee"]["p99_ack_ms"],
