@@ -254,3 +254,79 @@ def test_doc_partitioned_appliers_and_rebalance(tmp_path):
             if p.poll() is None:
                 p.terminate()
                 p.wait(timeout=10)
+
+
+def test_full_production_composition(tmp_path):
+    """EVERY tier at once, each its own OS process: storage server
+    (commit/ref DAG), ordering core over the durable log with an
+    external scribe, a scribe stage, two partitioned applier stages,
+    and a gateway terminating the client socket. A client edits through
+    the gateway, its summary is validated by the scribe PROCESS, the
+    ref advances in the storage PROCESS, a fresh client boots from it,
+    and an applier stage reports the doc applied to the stream tail."""
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+    from fluidframework_tpu.service.stage_runner import doc_partition
+    from fluidframework_tpu.service.storage_client import (
+        RemoteStorage,
+        StorageConnection,
+    )
+
+    log_dir = tmp_path / "log"
+    sstate = tmp_path / "scribe"
+    astates = [tmp_path / "ap0", tmp_path / "ap1"]
+    procs = []
+    try:
+        store, line = _spawn(
+            ["fluidframework_tpu.service.storage_server",
+             "--dir", str(tmp_path / "store")], "LISTENING")
+        procs.append(store)
+        sport = int(line.rsplit(":", 1)[1])
+        procs.append(_spawn_stage("scribe", log_dir, sstate))
+        for i, st in enumerate(astates):
+            p, _ = _spawn(
+                ["fluidframework_tpu.service.stage_runner", "--stage",
+                 "applier", "--log-dir", str(log_dir),
+                 "--state-dir", str(st), "--partition", f"{i}/2"],
+                "READY")
+            procs.append(p)
+        core, line = _spawn(
+            ["fluidframework_tpu.service.front_end", "--port", "0",
+             "--log-dir", str(log_dir),
+             "--storage-server", str(sport), "--external-scribe",
+             "--consume-backchannel", str(sstate),
+             "--consume-backchannel", str(astates[0]),
+             "--consume-backchannel", str(astates[1])], "LISTENING")
+        procs.append(core)
+        port = int(line.rsplit(":", 1)[1])
+        gw, line = _spawn(["fluidframework_tpu.service.gateway",
+                           "--core-port", str(port)], "LISTENING")
+        procs.append(gw)
+        gport = int(line.rsplit(":", 1)[1])
+
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", gport))
+        c1 = loader.resolve("t", "doc")
+        sm = SummaryManager(c1, max_ops=6)
+        s = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        for w in ("full ", "stack "):
+            s.insert_text(0, w)
+        assert wait_for(lambda: sm.summaries_acked >= 1, timeout=60)
+
+        st = RemoteStorage(StorageConnection("127.0.0.1", sport),
+                           "t", "doc")
+        assert st.get_ref() is not None      # scribe→core→storage ref
+        c2 = loader.resolve("t", "doc")      # boots from the ref
+        assert c2._base_snapshot is not None
+        assert wait_for(lambda: c2.runtime.get_data_store("default")
+                        .get_channel("text").get_text()
+                        == "stack full ")
+        owner = doc_partition("t", "doc", 2)
+        tail = c1.delta_manager.last_processed_seq
+        assert wait_for(
+            lambda: _applied_seq(astates[owner], "t", "doc") >= tail,
+            timeout=90)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                p.wait(timeout=10)
