@@ -55,6 +55,11 @@ class NativeOpLog:
     def length(self, topic: str) -> int:
         n = self._lib.oplog_length(self._handle, topic.encode())
         if n < 0:
+            # readonly consumers race topic creation: a topic the
+            # producer hasn't created yet has length 0, same contract as
+            # refresh(). Writers auto-create, so -1 there is a real error.
+            if self.readonly:
+                return 0
             raise OSError(f"bad topic {topic!r}")
         return n
 

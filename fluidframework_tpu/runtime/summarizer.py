@@ -46,6 +46,7 @@ class SummaryManager:
         self._proposal_heads: dict[str, int] = {}  # handle → capture seq
         self._pending_handle: Optional[str] = None
         self._ops_since_ack = 0
+        self._nack_retries = 0
         self.summaries_acked = 0
         self.summaries_nacked = 0
         # seed the head from storage: a manager attached after boot missed
@@ -90,6 +91,7 @@ class SummaryManager:
             self.last_acked_capture_seq = self._proposal_heads.pop(handle, None)
             self._proposal_heads.clear()  # older proposals can never ack now
             self._ops_since_ack = 0
+            self._nack_retries = 0
             if handle == self._pending_handle:
                 self._pending_handle = None
                 self.summaries_acked += 1
@@ -101,14 +103,28 @@ class SummaryManager:
                     and self._pending_handle is not None:
                 self._pending_handle = None
                 self.summaries_nacked += 1
+                # safe retry (ref: summaryNack → retry, summarizer.ts:
+                # 403-428): without it a transient nack (e.g. a parent
+                # raced another client's ack) strands the attempt until
+                # the next op — which may never come on an idle doc.
+                # Refresh the head from storage first so a parent-
+                # mismatch retry proposes against the REAL chain instead
+                # of failing identically.
+                if self._nack_retries < 2:
+                    self._nack_retries += 1
+                    versions = self.container.storage.get_versions(1)
+                    if versions:
+                        self.last_acked_handle = versions[0]["id"]
+                        self.last_acked_capture_seq = None
+                    self._maybe_summarize(force=True)
             return
         if msg.type == MessageType.OPERATION:
             self._ops_since_ack += 1
             self._maybe_summarize()
 
-    def _maybe_summarize(self) -> None:
+    def _maybe_summarize(self, force: bool = False) -> None:
         if (
-            self._ops_since_ack < self.max_ops
+            (self._ops_since_ack < self.max_ops and not force)
             or not self.is_summarizer
             or self._pending_handle is not None
             or not self.container.connected

@@ -53,11 +53,127 @@ def _unwrap(value: Any) -> Any:
     return value
 
 
+# --------------------------------------------------- binary fast path
+# The split deployment's hot records are (a) a raw ArrayBoxcar on the
+# rawops topic and (b) the ticketed {"abatch": SequencedArrayBatch}
+# record on the deltas topic — and (b) embeds the very boxcar object (a)
+# just carried. Packing those as struct+array bytes (instead of
+# wrap-recursion + b64 + json) and memoizing the boxcar's encoding on
+# the object makes the second append nearly free; everything else stays
+# on the frozen JSON path. 0xFF can never begin a JSON record.
+
+_BIN_MARK = 0xFF
+_BIN_RAW_ABOX = 1
+_BIN_ABATCH = 2
+
+
+def _abox_bytes(box) -> bytes:
+    import numpy as np
+
+    cached = getattr(box, "_wire_cache", None)
+    if cached is not None:
+        return cached
+    hdr = json.dumps(
+        [box.tenant_id, box.document_id, box.client_id, box.ds_id,
+         box.channel_id, box.timestamp, int(box.n), box.props],
+        separators=(",", ":")).encode()
+    text = box.text.encode()
+    data = b"".join((
+        len(hdr).to_bytes(4, "little"), hdr,
+        np.ascontiguousarray(box.kind, np.int8).tobytes(),
+        np.ascontiguousarray(box.a, np.int32).tobytes(),
+        np.ascontiguousarray(box.b, np.int32).tobytes(),
+        np.ascontiguousarray(box.cseq, np.int32).tobytes(),
+        np.ascontiguousarray(box.rseq, np.int32).tobytes(),
+        np.ascontiguousarray(box.text_off, np.int32).tobytes(),
+        len(text).to_bytes(4, "little"), text,
+    ))
+    box._wire_cache = data
+    return data
+
+
+def _abox_from(data: bytes, off: int):
+    import numpy as np
+
+    from .array_batch import ArrayBoxcar
+
+    hlen = int.from_bytes(data[off:off + 4], "little")
+    off += 4
+    tenant, doc, client, ds, ch, ts, n, props = json.loads(
+        data[off:off + hlen].decode())
+    off += hlen
+    kind = np.frombuffer(data, np.int8, n, off); off += n
+    a = np.frombuffer(data, np.int32, n, off); off += 4 * n
+    b = np.frombuffer(data, np.int32, n, off); off += 4 * n
+    cseq = np.frombuffer(data, np.int32, n, off); off += 4 * n
+    rseq = np.frombuffer(data, np.int32, n, off); off += 4 * n
+    text_off = np.frombuffer(data, np.int32, n + 1, off); off += 4 * (n + 1)
+    tlen = int.from_bytes(data[off:off + 4], "little")
+    off += 4
+    text = data[off:off + tlen].decode()
+    return ArrayBoxcar(
+        tenant_id=tenant, document_id=doc, client_id=client, ds_id=ds,
+        channel_id=ch, kind=kind, a=a, b=b, cseq=cseq, rseq=rseq,
+        text=text, text_off=text_off, props=props, timestamp=ts)
+
+
+def _encode_binary(value: Any) -> bytes | None:
+    from .array_batch import ArrayBoxcar, SequencedArrayBatch
+
+    t = type(value)
+    if t is ArrayBoxcar:
+        return bytes((_BIN_MARK, _BIN_RAW_ABOX)) + _abox_bytes(value)
+    if t is dict and len(value) == 3:
+        batch = value.get("abatch")
+        if type(batch) is SequencedArrayBatch:
+            import struct
+
+            import numpy as np
+
+            return b"".join((
+                bytes((_BIN_MARK, _BIN_ABATCH)),
+                struct.pack("<qdI", batch.base_seq, batch.timestamp,
+                            batch.n),
+                np.ascontiguousarray(batch.msns, np.int64).tobytes(),
+                _abox_bytes(batch.boxcar),
+            ))
+    return None
+
+
+def _decode_binary(data: bytes) -> Any:
+    import struct
+
+    import numpy as np
+
+    from .array_batch import SequencedArrayBatch
+
+    kind = data[1]
+    if kind == _BIN_RAW_ABOX:
+        return _abox_from(data, 2)
+    if kind == _BIN_ABATCH:
+        base_seq, ts, n = struct.unpack_from("<qdI", data, 2)
+        off = 2 + struct.calcsize("<qdI")
+        msns = np.frombuffer(data, np.int64, n, off)
+        off += 8 * n
+        box = _abox_from(data, off)
+        return {"tenant_id": box.tenant_id,
+                "document_id": box.document_id,
+                "abatch": SequencedArrayBatch(
+                    boxcar=box, base_seq=base_seq, msns=msns,
+                    timestamp=ts)}
+    raise ValueError(f"unknown binary record kind {kind}")
+
+
 def _encode_value(value: Any) -> bytes:
+    data = _encode_binary(value)
+    if data is not None:
+        return data
     return json.dumps(_wrap(value), separators=(",", ":")).encode()
 
 
 def _decode_value(data: bytes) -> Any:
+    if data[:1] == b"\xff":
+        return _decode_binary(data)
     return _unwrap(json.loads(data.decode()))
 
 

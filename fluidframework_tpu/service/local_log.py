@@ -87,7 +87,14 @@ class OrderedLogBase:
             # this or other topics); the outer loop reaches the fixed point
             try:
                 for handler, pos in list(self._subs.get(topic, [])):
-                    while pos[0] < self._stored_length(topic):
+                    # snapshot the length once per handler pass: for the
+                    # durable log it is a ctypes call, and re-querying
+                    # per record made it ~4 calls/record on the hot
+                    # path. Records a handler appends to THIS topic
+                    # re-dirty it, so the fixed-point loop still
+                    # delivers them.
+                    n = self._stored_length(topic)
+                    while pos[0] < n:
                         msg = QueuedMessage(
                             offset=pos[0], topic=topic, partition=0,
                             value=self._load(topic, pos[0]))

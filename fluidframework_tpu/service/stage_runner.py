@@ -152,9 +152,15 @@ class ScribeStage(_StageHostBase):
 
     # uploads BEFORE deltas: an upload announcement always precedes its
     # SUMMARIZE op on disk (the core appends + flushes it during the
-    # storage RPC, before the client can submit), and the poll/drain
-    # cycle visits topics in subscription order — so validation never
-    # sees a summarize whose upload record it hasn't ingested yet
+    # storage RPC, before the client can submit), and poll marks dirty /
+    # drain delivers in SUBSCRIPTION order — so as long as the doc's
+    # uploads topic is subscribed before its deltas topic, validation
+    # never sees a summarize whose upload record it hasn't ingested.
+    # attach() enforces that order by eagerly subscribing the uploads
+    # topic when the deltas topic appears (the uploads topic is usually
+    # created on disk much later — first upload — and discovery alone
+    # would subscribe it AFTER deltas, racing any summarize that lands
+    # in the same poll window as its upload: round-5 flake fix)
     topic_prefixes = ("uploads/", "deltas/")
 
     def __init__(self, log_dir: str, state_dir: str,
@@ -197,6 +203,11 @@ class ScribeStage(_StageHostBase):
         tenant, doc = _doc_of(topic)
         scribe = self._scribe_for(tenant, doc)
         if topic.startswith("deltas/"):
+            # subscribe the doc's uploads topic FIRST (see class comment)
+            up_topic = f"uploads/{tenant}/{doc}"
+            if up_topic not in self._known:
+                self._known.add(up_topic)
+                self.attach(up_topic)
             cp = self.load_checkpoint(tenant, doc)
             start = cp["deltas_offset"] + 1 if cp else 0
             self.shared.subscribe(topic, scribe.handler, from_offset=start)
@@ -238,6 +249,13 @@ class ApplierStage(_StageHostBase):
         self.applier.set_replay_source(lambda t, d: [])
         self._ckpt_path = ckpt
         self._offsets: dict[str, int] = {}
+        # highest sequence number CONSUMED per topic (the consumer-group
+        # offset semantic): the stream tail includes messages the applier
+        # skips (joins, summarize/ack, other channels), and "caught up"
+        # must mean consumed-through-tail, not merely
+        # last-applicable-op-applied — otherwise a stream ending in a
+        # summary ack reads as forever lagging
+        self._watermarks: dict[str, int] = {}
 
     def attach(self, topic: str) -> None:
         tenant, doc = _doc_of(topic)
@@ -249,11 +267,16 @@ class ApplierStage(_StageHostBase):
             value = message.value
             abatch = value.get("abatch")
             if abatch is not None:
+                self._watermarks[topic] = max(
+                    self._watermarks.get(topic, 0), abatch.last_seq)
                 if abatch.last_seq > self.applier.applied_seq(tenant, doc):
                     self.applier.ingest_array_batch(tenant, doc, abatch)
                 return
             batch = value.get("boxcar")
             msgs = batch if batch is not None else [value["message"]]
+            self._watermarks[topic] = max(
+                self._watermarks.get(topic, 0),
+                msgs[-1].sequence_number)
             # replay idempotency: the farm checkpoint is saved BEFORE
             # the offset checkpoints, so a crash in between replays a
             # window of already-applied ops — skip by sequence number
@@ -289,7 +312,9 @@ class ApplierStage(_StageHostBase):
             tenant, doc = _doc_of(topic)
             self.save_checkpoint(tenant, doc, {"offset": offset})
             self.emit({"kind": "applied", "tenant": tenant, "doc": doc,
-                       "applied_seq": self.applier.applied_seq(tenant, doc)})
+                       "applied_seq": max(
+                           self._watermarks.get(topic, 0),
+                           self.applier.applied_seq(tenant, doc))})
 
 
 STAGES = {"scribe": ScribeStage, "applier": ApplierStage}
