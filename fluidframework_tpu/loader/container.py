@@ -30,7 +30,14 @@ class Container:
         service: DocumentService,
         runtime_factory: Optional[Callable[["Container"], ContainerRuntime]] = None,
         code_loader=None,
+        auto_reconnect: bool = False,
     ):
+        # auto_reconnect: re-dial after a SERVER-initiated drop with
+        # backoff (ref: the deltaManager.ts:294,444 reconnect state
+        # machine, where it is the default). Opt-in here; the sharded
+        # core's failover path relies on it (a doc's partition moving to
+        # a takeover core drops the session mid-stream).
+        self.auto_reconnect = auto_reconnect
         self._service = service
         self._code_loader = code_loader
         self.storage = service.connect_to_storage()
@@ -196,6 +203,42 @@ class Container:
             self._my_client_ids.add(client_id)
         if self.runtime is not None:
             self.runtime.set_connection_state(connected, client_id)
+        if (not connected and self.auto_reconnect and not self.closed
+                and not self.delta_manager.user_disconnected):
+            import threading
+
+            threading.Thread(target=self._reconnect_loop,
+                             daemon=True).start()
+
+    def _reconnect_loop(self) -> None:
+        """Server-initiated drop: re-dial with backoff until the doc is
+        served again (e.g. its partition's takeover core claimed the
+        lease) or the container closes."""
+        import time
+
+        delay = 0.1
+        while not self.closed and not self.connected:
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+            if self.closed or self.connected \
+                    or self.delta_manager.user_disconnected:
+                return
+            try:
+                self.delta_manager.connect()
+            except Exception:  # noqa: BLE001 — core still down: retry
+                continue
+            # connect() returning is NOT success: the connection only
+            # activates when our join round-trips, and a pending
+            # connection that dies fires no handler (was_active=False)
+            # — so wait bounded here and retry instead of returning
+            t0 = time.time()
+            while (not self.closed and not self.connected
+                   and self.delta_manager.pending_connection is not None
+                   and time.time() - t0 < 10.0):
+                time.sleep(0.05)
+            if self.connected:
+                return
+            self.delta_manager.abort_pending()
 
     def _on_nack(self, nack: Nack) -> None:
         # a nack means our op stream is broken at the server: the recovery
@@ -217,17 +260,20 @@ class Loader:
         factory: DocumentServiceFactory,
         runtime_factory: Optional[Callable[[Container], ContainerRuntime]] = None,
         code_loader=None,
+        auto_reconnect: bool = False,
     ):
         self._factory = factory
         self._runtime_factory = runtime_factory
         self._code_loader = code_loader
+        self._auto_reconnect = auto_reconnect
 
     def resolve(
         self, tenant_id: str, document_id: str, connect: bool = True
     ) -> Container:
         service = self._factory.create_document_service(tenant_id, document_id)
         return Container(service, self._runtime_factory,
-                         code_loader=self._code_loader).load(connect)
+                         code_loader=self._code_loader,
+                         auto_reconnect=self._auto_reconnect).load(connect)
 
     def create_detached(self, tenant_id: str, document_id: str) -> Container:
         """A container that lives entirely client-side until ``attach()``

@@ -34,6 +34,10 @@ class DeltaManager:
         self._delta_storage = service.connect_to_delta_storage()
         self.connection = None
         self._pending_connection = None  # opened, but our join not yet seen
+        # True while the CLIENT chose to be offline (disconnect()); a
+        # server-initiated drop leaves it False, which is what an
+        # auto-reconnect policy keys on
+        self.user_disconnected = False
         self.client_id: Optional[str] = None
         self.last_processed_seq = 0
         self.minimum_sequence_number = 0
@@ -82,16 +86,32 @@ class DeltaManager:
         """
         if self.connection is not None or self._pending_connection is not None:
             return self.client_id
+        self.user_disconnected = False
         self._details = details if details is not None else self._details
         conn = self._service.connect_to_delta_stream(self._details)
         self._pending_connection = conn
-        conn.on_nack = self._on_nack
-        conn.on_signal = self._on_signal
-        conn.on_disconnect = lambda reason: self._on_disconnect(reason)
-        conn.on_op = self._enqueue  # assigning flushes buffered events
-        # repair any gap between our head and the pre-subscription history;
-        # everything from the handshake on arrives live (incl. our join)
-        self._fetch_missing(upto=conn.initial_sequence_number)
+        try:
+            conn.on_nack = self._on_nack
+            conn.on_signal = self._on_signal
+            conn.on_disconnect = lambda reason: self._on_disconnect(reason)
+            conn.on_op = self._enqueue  # assigning flushes buffered events
+            # repair any gap between our head and the pre-subscription
+            # history; everything from the handshake on arrives live
+            # (incl. our join)
+            self._fetch_missing(upto=conn.initial_sequence_number)
+        except BaseException:
+            # a half-opened connection must not wedge future connects: a
+            # still-pending _pending_connection makes connect() an early-
+            # return no-op, which an auto-reconnect loop would read as
+            # success and stop retrying
+            if self._pending_connection is conn:
+                self._pending_connection = None
+            conn.on_disconnect = None
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
         if getattr(conn, "mode", "write") == "read":
             # read connections never join the quorum, so there is no join
             # round-trip to wait for: they go active immediately (and the
@@ -108,7 +128,24 @@ class DeltaManager:
         if self.connection_handler:
             self.connection_handler(True, self.client_id)
 
+    @property
+    def pending_connection(self):
+        """The opened-but-not-yet-active connection (join in flight)."""
+        return self._pending_connection
+
+    def abort_pending(self) -> None:
+        """Drop a pending connection WITHOUT marking a user disconnect —
+        the auto-reconnect loop's cleanup when a join never lands."""
+        conn, self._pending_connection = self._pending_connection, None
+        if conn is not None:
+            conn.on_disconnect = None
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     def disconnect(self, reason: str = "client disconnect") -> None:
+        self.user_disconnected = True
         conn = self.connection or self._pending_connection
         if conn is None:
             return

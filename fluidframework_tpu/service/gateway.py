@@ -49,6 +49,7 @@ class _GatewaySession:
         self.sid: Optional[int] = None
         self.topic: Optional[str] = None
         self.binary = False  # client negotiated binwire ops push
+        self.up: Optional[_Upstream] = None  # owning core's backbone
         # While a connect awaits the core's auth verdict, broadcasts are
         # held here instead of the socket; flushed on success, dropped on
         # refusal. None = no gate (normal delivery).
@@ -90,15 +91,20 @@ class _GatewaySession:
             gw.sessions[self.sid] = self
             gw.topic_sessions.setdefault(self.topic, set()).add(self)
             try:
-                # the gateway ALWAYS asks the core for binary fops — it
-                # relays them to binary clients by byte-slicing and
-                # re-encodes JSON locally for legacy clients, keeping the
-                # expensive per-op encode off the core either way
+                # route to the doc's owning core (sharded mode resolves
+                # the partition lease; classic mode returns THE core).
+                # The gateway ALWAYS asks for binary fops — it relays
+                # them to binary clients by byte-slicing and re-encodes
+                # JSON locally for legacy clients, keeping the expensive
+                # per-op encode off the core either way.
+                self.up = await gw.upstream_for(frame["tenant"],
+                                                frame["doc"])
+                self.up.sessions.add(self.sid)
                 reply = await gw.upstream_request({
                     "t": "fconnect", "sid": self.sid,
                     "tenant": frame["tenant"], "doc": frame["doc"],
                     "details": frame.get("details"),
-                    "token": frame.get("token"), "bin": 1})
+                    "token": frame.get("token"), "bin": 1}, self.up)
             except BaseException:
                 self._gate_buffer = None
                 self.detach()
@@ -111,19 +117,25 @@ class _GatewaySession:
             for raw in buffered:
                 self.push_raw(raw)
         elif t == "submit":
+            if self.up is None:
+                raise RuntimeError("submit before connect")
             # ops pass through verbatim — no payload re-encode
             gw.upstream_send({"t": "fsubmit", "sid": self.sid,
-                              "ops": frame["ops"]})
+                              "ops": frame["ops"]}, self.up)
         elif t == "signal":
+            if self.up is None:
+                raise RuntimeError("signal before connect")
             gw.upstream_send({"t": "fsignal", "sid": self.sid,
                               "content": frame["content"],
-                              "type": frame.get("type", "signal")})
+                              "type": frame.get("type", "signal")},
+                             self.up)
         elif t == "disconnect":
             self.detach()
         elif t in ("get_deltas", "get_versions", "get_tree", "read_blob",
                    "write_blob", "upload_summary"):
+            up = await gw.upstream_for(frame["tenant"], frame["doc"])
             reply = await gw.upstream_request(
-                {k: v for k, v in frame.items() if k != "rid"})
+                {k: v for k, v in frame.items() if k != "rid"}, up)
             reply["rid"] = frame.get("rid")
             self.push(reply)
         else:
@@ -139,13 +151,37 @@ class _GatewaySession:
                     peers.discard(self)
                     if not peers:  # prune emptied topics
                         self.gw.topic_sessions.pop(self.topic, None)
-            self.gw.upstream_send({"t": "fdisconnect", "sid": self.sid})
+            if self.up is not None:
+                self.up.sessions.discard(self.sid)
+                if not self.up.writer.is_closing():
+                    self.gw.upstream_send(
+                        {"t": "fdisconnect", "sid": self.sid}, self.up)
+                self.up = None
             self.sid = None
 
 
+class _Upstream:
+    """One backbone connection to one core process."""
+
+    def __init__(self, gw: "Gateway", address: str,
+                 writer: asyncio.StreamWriter):
+        self.gw = gw
+        self.address = address
+        self.writer = writer
+        self.sessions: set[int] = set()  # sids registered on this core
+        self.pending_rids: set[int] = set()  # in-flight requests HERE
+
+
 class Gateway:
+    """``shard_dir``/``shards`` switch on sharded-core routing: each doc
+    routes to the core holding its partition's lease (placement.py); a
+    core's death kills only ITS sessions, and the next resolution picks
+    up the takeover owner. Without them the gateway runs the classic
+    single-core topology."""
+
     def __init__(self, core_host: str, core_port: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 shard_dir: Optional[str] = None, shards: int = 0):
         self.core_host, self.core_port = core_host, core_port
         self.host, self.port = host, port
         self.sessions: dict[int, _GatewaySession] = {}
@@ -153,36 +189,93 @@ class Gateway:
         self.sid_counter = itertools.count(1)
         self._rid_counter = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
-        self._up_writer: Optional[asyncio.StreamWriter] = None
+        self.placement = None
+        if shard_dir is not None:
+            import os
+
+            from .placement import PlacementDir
+
+            self.placement = PlacementDir(
+                os.path.join(shard_dir, "placement"), shards)
+        self._upstreams: dict[str, _Upstream] = {}
+        self._up_default: Optional[_Upstream] = None
 
     # ----------------------------------------------------------- upstream
 
-    async def _connect_upstream(self) -> None:
+    async def _open_upstream(self, address: str) -> _Upstream:
+        up = self._upstreams.get(address)
+        if up is not None and not up.writer.is_closing():
+            return up
+        host, _, port = address.rpartition(":")
         reader, writer = await asyncio.open_connection(
-            self.core_host, self.core_port)
+            host or "127.0.0.1", int(port))
         sock = writer.get_extra_info("socket")
         if sock is not None:
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        self._up_writer = writer
-        asyncio.get_running_loop().create_task(self._upstream_loop(reader))
+        up = _Upstream(self, address, writer)
+        self._upstreams[address] = up
+        asyncio.get_running_loop().create_task(
+            self._upstream_loop(reader, up))
+        return up
 
-    def upstream_send(self, obj: dict) -> None:
-        self._up_writer.write(_encode_frame(obj))
+    async def _connect_upstream(self) -> None:
+        if self.placement is None:
+            self._up_default = await self._open_upstream(
+                f"{self.core_host}:{self.core_port}")
 
-    def upstream_send_raw(self, raw: bytes) -> None:
-        self._up_writer.write(raw)
+    async def upstream_for(self, tenant: str, doc: str) -> _Upstream:
+        """The backbone connection of the core owning this doc."""
+        if self.placement is None:
+            if self._up_default is None or \
+                    self._up_default.writer.is_closing():
+                self._up_default = None
+                await self._connect_upstream()
+            return self._up_default
+        from .stage_runner import doc_partition
 
-    async def upstream_request(self, obj: dict) -> dict:
+        k = doc_partition(tenant, doc, self.placement.n)
+        deadline = asyncio.get_running_loop().time() + 15.0
+        while True:
+            addr = self.placement.owner_of(k)
+            if addr is not None:
+                try:
+                    return await self._open_upstream(addr)
+                except OSError:
+                    pass  # owner died between lease read and connect
+            if asyncio.get_running_loop().time() > deadline:
+                raise ConnectionError(
+                    f"no live core owns partition {k}")
+            await asyncio.sleep(0.2)
+
+    def upstream_send(self, obj: dict, up: Optional[_Upstream] = None
+                      ) -> None:
+        (up or self._up_default).writer.write(_encode_frame(obj))
+
+    def upstream_send_raw(self, raw: bytes,
+                          up: Optional[_Upstream] = None) -> None:
+        (up or self._up_default).writer.write(raw)
+
+    async def upstream_request(self, obj: dict,
+                               up: Optional[_Upstream] = None) -> dict:
         rid = next(self._rid_counter)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        self.upstream_send(dict(obj, rid=rid))
-        reply = await fut
+        target = up or self._up_default
+        if target is None:
+            raise ConnectionError("no live core connection")
+        target.pending_rids.add(rid)
+        try:
+            self.upstream_send(dict(obj, rid=rid), target)
+            reply = await fut
+        finally:
+            target.pending_rids.discard(rid)
+            self._pending.pop(rid, None)
         if reply.get("t") == "error":
             raise RuntimeError(f"core error: {reply.get('message')}")
         return reply
 
-    async def _upstream_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _upstream_loop(self, reader: asyncio.StreamReader,
+                             up: _Upstream) -> None:
         try:
             while True:
                 body = await _read_body(reader)
@@ -193,15 +286,25 @@ class Gateway:
                 else:
                     self._dispatch_upstream(json.loads(body.decode()))
         finally:
-            # core gone: every client of this gateway is dead too
-            for session in list(self.sessions.values()):
-                try:
-                    session.writer.close()
-                except Exception:
-                    pass
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("core disconnected"))
+            # this core is gone: only ITS clients are dead. In sharded
+            # mode the takeover core will serve them on reconnect.
+            self._upstreams.pop(up.address, None)
+            if self._up_default is up:
+                self._up_default = None
+            for sid in list(up.sessions):
+                session = self.sessions.get(sid)
+                if session is not None:
+                    try:
+                        session.writer.close()
+                    except Exception:
+                        pass
+            # fail exactly THIS core's in-flight requests — a request
+            # pending on a live core must keep waiting for its reply
+            for rid in list(up.pending_rids):
+                fut = self._pending.pop(rid, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(
+                        ConnectionError("core disconnected"))
 
     def _dispatch_upstream_binary(self, body: bytes) -> None:
         """Relay a binary fops batch: byte-slice for binary clients (no
@@ -243,6 +346,16 @@ class Gateway:
             raw = _encode_frame({"t": "signal", "signal": frame["signal"]})
             for session in self.topic_sessions.get(frame["topic"], ()):
                 session.push_raw(raw)
+        elif t == "fdropped":
+            # the core revoked this client's partition (lease moved):
+            # close just that client; its auto-reconnect re-resolves the
+            # owner and lands on the takeover core
+            session = self.sessions.get(frame["sid"])
+            if session is not None:
+                try:
+                    session.writer.close()
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------- clients
 
@@ -261,9 +374,11 @@ class Gateway:
                     # hot path: rewrite submit → fsubmit by prepending the
                     # sid — op payloads are relayed, never decoded here
                     if (len(body) >= 2 and body[1] == binwire.FT_SUBMIT
-                            and session.sid is not None):
+                            and session.sid is not None
+                            and session.up is not None):
                         self.upstream_send_raw(binwire.frame(
-                            binwire.submit_to_fsubmit(body, session.sid)))
+                            binwire.submit_to_fsubmit(body, session.sid)),
+                            session.up)
                     else:
                         session.push({"t": "error",
                                       "message": "unexpected binary frame"})
@@ -306,16 +421,46 @@ def main() -> None:
 
     p = argparse.ArgumentParser(description="Fluid TPU gateway front end")
     p.add_argument("--core-host", default="127.0.0.1")
-    p.add_argument("--core-port", type=int, required=True)
+    p.add_argument("--core-port", type=int, default=0,
+                   help="single-core topology (omit with --shard-dir)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--shard-dir", default=None,
+                   help="sharded-core deployment dir (placement leases); "
+                        "docs route to their partition's owning core")
+    p.add_argument("--shards", type=int, default=0,
+                   help="number of doc partitions in the sharded core")
+    p.add_argument("--python", action="store_true",
+                   help="force the asyncio relay (compat path: serves "
+                        "JSON-ops legacy clients the native loop refuses)")
     args = p.parse_args()
+    if args.shard_dir is None and not args.core_port:
+        p.error("--core-port is required without --shard-dir")
+    if not args.python and args.shard_dir is None:
+        # default: the C++ epoll relay (native/gateway.cpp) — zero
+        # Python on the hot path (VERDICT r4 #3, SURVEY §2.9). Falls
+        # back to asyncio if the toolchain can't build it.
+        try:
+            from ..native.build import NativeUnavailable
+            from ..native.gateway import NativeGateway
+
+            try:
+                ng = NativeGateway(args.core_host, args.core_port,
+                                   host=args.host, port=args.port)
+            except NativeUnavailable:
+                ng = None
+        except Exception:
+            ng = None
+        if ng is not None:
+            print(f"LISTENING {args.host}:{ng.port}", flush=True)
+            raise SystemExit(0 if ng.run() == 0 else 1)
     # relay path allocates acyclic graphs only; cycle-collector pauses
     # would land directly on forwarded-frame latency (see front_end main)
     gc.freeze()
     gc.disable()
     Gateway(args.core_host, args.core_port,
-            host=args.host, port=args.port).serve_forever()
+            host=args.host, port=args.port,
+            shard_dir=args.shard_dir, shards=args.shards).serve_forever()
 
 
 if __name__ == "__main__":

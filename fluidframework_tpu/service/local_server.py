@@ -211,6 +211,29 @@ class LocalServer:
         # vs remote by id), hence the random epoch component
         self._client_epoch = uuid.uuid4().hex[:6]
         self._client_counter = itertools.count(1)
+        # sharded core: set when this partition's lease was lost — every
+        # order path refuses, so a dispossessed server can never write
+        # its (now someone else's) durable log again
+        self._revoked = False
+        # lease fencing (sharded core): a callable returning True while
+        # this partition's lease was confirmed RECENTLY. Checked on
+        # every order path: a process that stalled past the TTL (GC
+        # pause, SIGSTOP) and wakes with buffered submits must refuse
+        # them BEFORE its heartbeat loop discovers the takeover —
+        # otherwise it interleaves appends into a log the new owner is
+        # already writing (the classic two-writer corruption).
+        self.lease_fresh = None
+
+    def revoke(self) -> None:
+        """Partition lease lost (ShardHost.poll): stop sequencing NOW.
+        The front end also tears down the partition's live sessions so
+        clients reconnect to the takeover owner."""
+        self._revoked = True
+
+    def _check_revoked(self) -> None:
+        if self._revoked or (self.lease_fresh is not None
+                             and not self.lease_fresh()):
+            raise RuntimeError("partition lease lost: reconnect")
 
     # ------------------------------------------------------------------ api
 
@@ -229,6 +252,7 @@ class LocalServer:
         tenantManager.verifyToken). A doc:read-only token gets a READ
         connection: it may watch the stream, but submits are nacked with
         InvalidScopeError (ref: readonly connections, tokens.ts scopes)."""
+        self._check_revoked()
         can_write = True
         if self.tenants is not None:
             from .tenants import SCOPE_READ, SCOPE_WRITE
@@ -368,6 +392,7 @@ class LocalServer:
         return self._orderers[key]
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:
+        self._check_revoked()
         if not getattr(conn, "can_write", True):
             from ..protocol.messages import Nack, NackErrorType
 
@@ -395,6 +420,7 @@ class LocalServer:
         self._maybe_drain()
 
     def _submit_array(self, conn: ServerConnection, boxcar) -> None:
+        self._check_revoked()
         if not getattr(conn, "can_write", True):
             from ..protocol.messages import Nack, NackErrorType
 
@@ -418,8 +444,10 @@ class LocalServer:
             f"signal/{conn.tenant_id}/{conn.document_id}", signal)
 
     def _disconnect(self, conn: ServerConnection) -> None:
-        if not getattr(conn, "can_write", True):
-            # read connections never joined: nothing to leave
+        if self._revoked or not getattr(conn, "can_write", True):
+            # revoked: the takeover owner expires the client instead
+            # (idle timeout) — this process may not write the log.
+            # read connections never joined: nothing to leave.
             self._unsubscribe_conn(conn)
             return
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)

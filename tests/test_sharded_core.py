@@ -1,0 +1,242 @@
+"""Sharded ordering core: N core processes over placement leases.
+
+Ref: memory-orderer/src/reservationManager.ts:21 (lease-based doc
+ownership), remoteNode.ts:92 (routing to the owner). The deployment under
+test: two core processes each claiming one doc partition (per-partition
+durable logs under a shared deployment dir), a routing gateway resolving
+each doc's owner from the lease directory, and clients with
+auto-reconnect riding through a core's death — the killed core's
+partition goes stale, the survivor claims it, resumes the partition's
+pipeline from ITS OWN durable log, and the clients' reconnect lands on
+the survivor with their pending edits rebased.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service.stage_runner import doc_partition
+
+TTL = "1.5"  # fast takeover so the failover test stays quick
+
+
+def wait_for(cond, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _spawn(args, tmp_path):
+    errf = open(os.path.join(tmp_path, f"err-{len(os.listdir(tmp_path))}.log"),
+                "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        stdout=subprocess.PIPE, stderr=errf, text=True, cwd="/root/repo")
+    proc._stderr_path = errf.name
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+def _core(tmp_path, shard_dir, prefer):
+    return _spawn(["fluidframework_tpu.service.front_end", "--port", "0",
+                   "--shard-dir", str(shard_dir), "--shards", "2",
+                   "--prefer", prefer, "--lease-ttl", TTL], tmp_path)
+
+
+def _docs_for_both_partitions(n_each=2):
+    """Doc names covering partition 0 and 1 of the 2-shard map."""
+    by_part = {0: [], 1: []}
+    i = 0
+    while any(len(v) < n_each for v in by_part.values()):
+        d = f"sdoc{i}"
+        k = doc_partition("t", d, 2)
+        if len(by_part[k]) < n_each:
+            by_part[k].append(d)
+        i += 1
+    return by_part
+
+
+def test_two_cores_serve_their_partitions_and_survive_takeover(tmp_path):
+    shard_dir = tmp_path / "deploy"
+    procs = []
+    try:
+        core0, p0 = _core(tmp_path, shard_dir, "0")
+        procs.append(core0)
+        core1, p1 = _core(tmp_path, shard_dir, "1")
+        procs.append(core1)
+        gw, gport = _spawn(
+            ["fluidframework_tpu.service.gateway", "--shard-dir",
+             str(shard_dir), "--shards", "2"], tmp_path)
+        procs.append(gw)
+
+        by_part = _docs_for_both_partitions(n_each=1)
+        d0, d1 = by_part[0][0], by_part[1][0]
+
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", gport),
+                        auto_reconnect=True)
+        c0 = loader.resolve("t", d0)
+        c1 = loader.resolve("t", d1)
+        s0 = c0.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s1 = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s0.insert_text(0, "part zero ")
+        s1.insert_text(0, "part one ")
+        assert wait_for(lambda: c0.runtime.pending.count == 0
+                        and c1.runtime.pending.count == 0)
+
+        # both cores are live and each doc is served by its partition's
+        # owner — a second client on another connection converges
+        c0b = loader.resolve("t", d0)
+        assert wait_for(
+            lambda: "default" in c0b.runtime.data_stores
+            and "text" in c0b.runtime.get_data_store("default").channels
+            and c0b.runtime.get_data_store("default").get_channel(
+                "text").get_text() == "part zero ")
+
+        # ---- kill core0: its partition moves to core1 ----
+        os.kill(core0.pid, signal.SIGKILL)
+        core0.wait(timeout=10)
+
+        # the survivor claims partition 0 after the lease goes stale and
+        # resumes its durable log; c0 auto-reconnects through the
+        # gateway and keeps editing the SAME doc
+        def can_edit():
+            if not c0.connected:
+                return False
+            try:
+                s0.insert_text(0, "x")
+                return True
+            except RuntimeError:
+                return False
+        assert wait_for(can_edit, timeout=30)
+        s0.insert_text(len(s0.get_text()), " moved")
+        assert wait_for(lambda: c0.runtime.pending.count == 0, timeout=30)
+
+        # a FRESH client boots the moved doc from the survivor: full
+        # history (pre-kill text included) came from partition 0's
+        # durable log, now owned by core1
+        c0c = loader.resolve("t", d0)
+        assert wait_for(
+            lambda: "default" in c0c.runtime.data_stores
+            and "text" in c0c.runtime.get_data_store("default").channels
+            and c0c.runtime.get_data_store("default").get_channel(
+                "text").get_text() == s0.get_text(), timeout=30)
+        assert "part zero" in s0.get_text() and "moved" in s0.get_text()
+
+        # the other partition was never disturbed
+        s1.insert_text(0, "still here ")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def test_stalled_core_is_revoked_and_clients_move(tmp_path):
+    """The two-writer hazard: a core that STALLS past the lease TTL
+    (SIGSTOP — the GC-pause/CPU-starvation model) is dispossessed while
+    still alive. On resume its next heartbeat fails, it revokes the
+    partition (order paths refuse; sessions are dropped), and the
+    clients land on the takeover owner via auto-reconnect. The stalled
+    incarnation must never sequence another op into the moved log."""
+    shard_dir = tmp_path / "deploy"
+    procs = []
+    try:
+        core0, p0 = _core(tmp_path, shard_dir, "0")
+        procs.append(core0)
+        core1, p1 = _core(tmp_path, shard_dir, "1")
+        procs.append(core1)
+        gw, gport = _spawn(
+            ["fluidframework_tpu.service.gateway", "--shard-dir",
+             str(shard_dir), "--shards", "2"], tmp_path)
+        procs.append(gw)
+
+        d0 = _docs_for_both_partitions(n_each=1)[0][0]
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", gport),
+                        auto_reconnect=True)
+        c = loader.resolve("t", d0)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "before stall ")
+        assert wait_for(lambda: c.runtime.pending.count == 0)
+
+        os.kill(core0.pid, signal.SIGSTOP)
+        time.sleep(float(TTL) + 1.0)  # lease goes stale; core1 claims
+        os.kill(core0.pid, signal.SIGCONT)
+
+        # the client's session (via core0) is dropped on revocation;
+        # auto-reconnect resolves the new owner and edits flow again
+        def can_edit():
+            if not c.connected:
+                return False
+            try:
+                s.insert_text(0, "y")
+                return True
+            except RuntimeError:
+                return False
+        assert wait_for(can_edit, timeout=30)
+        s.insert_text(len(s.get_text()), " after")
+        assert wait_for(lambda: c.runtime.pending.count == 0, timeout=30)
+
+        # a fresh boot sees a single consistent history from the
+        # takeover owner's log
+        c2 = loader.resolve("t", d0)
+        assert wait_for(
+            lambda: "default" in c2.runtime.data_stores
+            and "text" in c2.runtime.get_data_store("default").channels
+            and c2.runtime.get_data_store("default").get_channel(
+                "text").get_text() == s.get_text(), timeout=30)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)  # in case still stopped
+                except OSError:
+                    pass
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def test_lease_registry_claim_heartbeat_takeover(tmp_path):
+    from fluidframework_tpu.service.placement import PlacementDir
+
+    pd = PlacementDir(str(tmp_path / "pl"), 2, ttl_s=0.3)
+    assert pd.try_claim(0, "a", "addr-a")
+    assert pd.owner_of(0) == "addr-a"
+    # live lease refuses another claimant
+    assert not pd.try_claim(0, "b", "addr-b")
+    # heartbeat keeps it alive across the ttl
+    for _ in range(3):
+        time.sleep(0.15)
+        assert pd.heartbeat(0, "a")
+    assert pd.owner_of(0) == "addr-a"
+    # stop heartbeating: stale → takeover succeeds
+    time.sleep(0.4)
+    assert pd.owner_of(0) is None
+    assert pd.try_claim(0, "b", "addr-b")
+    assert pd.owner_of(0) == "addr-b"
+    # the loser notices on its next heartbeat and must stop serving
+    assert not pd.heartbeat(0, "a")
+    # release clears the file
+    pd.release(0, "b")
+    assert pd.owner_of(0) is None
