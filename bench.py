@@ -331,7 +331,7 @@ def bench_network() -> dict:
         # bursty co-tenant CPU (round-3 note), and one burst must not
         # stop the sweep at an artificially low knee. ----
         best = None
-        for rate in (1.25, 1.5, 1.75, 2.0):
+        for rate in (1.25, 1.5, 1.75, 2.0, 2.5):
             for attempt in ("", "b"):  # one retry per rung
                 r = run_workers(knee_ports, 4, 64, 2, rate, 32,
                                 max(8, int(8 * rate)), f"k{rate}{attempt}")
@@ -344,10 +344,12 @@ def bench_network() -> dict:
                     best = r  # even the lightest load misses: report it
                 break
         # confirm the knee: median p99 of 5 runs (bursty co-tenant CPU
-        # can depress two consecutive trials). If even the confirm
-        # median misses the target, step DOWN a rung and re-confirm —
-        # reporting a "knee" whose own confirmation failed would
-        # overclaim the sustainable load.
+        # can depress two consecutive trials). If the confirm median
+        # misses the target, step DOWN a rung and re-confirm, all the
+        # way to 0.5 (8k ops/s): the published knee is the highest rate
+        # whose own confirmation median holds p99 < 50 ms — never a
+        # rate that only hit the target in a lucky sweep run (VERDICT
+        # r4 #2: the knee must be honest even if it is small).
         knee_rate = best["rate_hz"]
         while True:
             confirms = sorted(
@@ -356,7 +358,7 @@ def bench_network() -> dict:
                  for t in range(5)),
                 key=lambda r: r["p99_ack_ms"])
             best = confirms[2]
-            if best["p99_ack_ms"] < 50.0 or knee_rate <= 1.0:
+            if best["p99_ack_ms"] < 50.0 or knee_rate <= 0.5:
                 break
             knee_rate = round(knee_rate - 0.25, 2)
 
@@ -370,7 +372,7 @@ def bench_network() -> dict:
         # lightest rate misses, the lightest run is reported and its
         # published p99 field is the saturation marker. ----
         cfg4 = None
-        for rate in (0.075, 0.05, 0.035):
+        for rate in (0.15, 0.125, 0.1, 0.075, 0.05, 0.035):
             for attempt in ("", "b"):  # one retry per rate: a single
                 # co-tenant burst inside a 30 s window poisons the p99
                 cfg4 = run_workers(gw_ports, 4, 250, 10, rate, 8, 3,

@@ -24,7 +24,7 @@ from fluidframework_tpu.loader import Loader
 DOC_ID = "clicker-demo"
 
 
-def wait_until(cond, timeout=20.0):
+def wait_until(cond, timeout=90.0):  # 1-CPU host: full-suite contention stretches acks
     t0 = time.time()
     while time.time() - t0 < timeout:
         if cond():
@@ -62,6 +62,42 @@ def run_clicker(port: int, clicks: int, creator: bool) -> None:
     print(json.dumps({"clicked": clicks, "sees": counter.value}))
 
 
+def run_clients(port: int, n_procs: int = 4, clicks: int = 25) -> int:
+    """Drive N clicker processes against an ALREADY-RUNNING service on
+    ``port`` (any topology — the dev host owns the deployment shape)."""
+    def spawn(creator):
+        args = [sys.executable, "-m", "examples.clicker",
+                "--connect", str(port), "--clicks", str(clicks)]
+        if creator:
+            args.append("--create")
+        return subprocess.Popen(args, stdout=subprocess.PIPE,
+                                stderr=sys.stderr, text=True)
+
+    first = spawn(True)
+    assert first.stdout.readline().strip() == "READY"
+    procs = [first] + [spawn(False) for _ in range(n_procs - 1)]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=220)
+            if p.returncode != 0:
+                print(f"clicker failed rc={p.returncode}")
+                return 1
+    finally:
+        for p in procs:  # a hung clicker must not outlive the run
+            if p.poll() is None:
+                p.kill()
+
+    # an observer verifies the converged total
+    _, counter = open_counter(port, creator=False)
+    want = n_procs * clicks
+    if not wait_until(lambda: counter.value == want):
+        print(f"DIVERGED: {counter.value} != {want}")
+        return 1
+    print(f"CONVERGED: {n_procs} processes x {clicks} clicks "
+          f"= {counter.value}")
+    return 0
+
+
 def run_demo(n_procs: int = 4, clicks: int = 25) -> int:
     server = subprocess.Popen(
         [sys.executable, "-m", "fluidframework_tpu.service.front_end",
@@ -70,33 +106,7 @@ def run_demo(n_procs: int = 4, clicks: int = 25) -> int:
     try:
         line = server.stdout.readline().strip()
         port = int(line.rsplit(":", 1)[1])
-
-        def spawn(creator):
-            args = [sys.executable, "-m", "examples.clicker",
-                    "--connect", str(port), "--clicks", str(clicks)]
-            if creator:
-                args.append("--create")
-            return subprocess.Popen(args, stdout=subprocess.PIPE,
-                                    stderr=sys.stderr, text=True)
-
-        first = spawn(True)
-        assert first.stdout.readline().strip() == "READY"
-        procs = [first] + [spawn(False) for _ in range(n_procs - 1)]
-        for p in procs:
-            out, _ = p.communicate(timeout=90)
-            if p.returncode != 0:
-                print(f"clicker failed rc={p.returncode}")
-                return 1
-
-        # an observer verifies the converged total
-        _, counter = open_counter(port, creator=False)
-        want = n_procs * clicks
-        if not wait_until(lambda: counter.value == want):
-            print(f"DIVERGED: {counter.value} != {want}")
-            return 1
-        print(f"CONVERGED: {n_procs} processes x {clicks} clicks "
-              f"= {counter.value}")
-        return 0
+        return run_clients(port, n_procs, clicks)
     finally:
         server.terminate()
         server.wait(timeout=10)

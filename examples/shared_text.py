@@ -119,7 +119,7 @@ def open_document(port: int,
     return container, doc
 
 
-def wait_until(cond, timeout=15.0):
+def wait_until(cond, timeout=90.0):  # 1-CPU host: full-suite contention stretches acks
     t0 = time.time()
     while time.time() - t0 < timeout:
         if cond():
@@ -180,6 +180,42 @@ def run_editor(port: int, name: str, script: str) -> None:
                       "text": doc.body.get_text()}))
 
 
+def run_clients(port: int) -> int:
+    """Drive the two editors against an ALREADY-RUNNING service on
+    ``port`` (any topology — the dev host owns the deployment shape)."""
+    def spawn(name, s):
+        return subprocess.Popen(
+            [sys.executable, "-m", "examples.shared_text",
+             "--connect", str(port), "--name", name, "--script", s],
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+
+    alice = spawn("alice", "a")
+    assert alice.stdout.readline().strip() == "READY"
+    editors = [alice, spawn("bob", "b")]
+    results = []
+    try:
+        for e in editors:
+            out, _ = e.communicate(timeout=220)
+            if e.returncode != 0:
+                print(f"editor failed rc={e.returncode}")
+                return 1
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for e in editors:  # a hung editor must not outlive the run
+            if e.poll() is None:
+                e.kill()
+    texts = {r["text"] for r in results}
+    print(f"\n=== {results[0]['name']}'s replica ===")
+    print(results[0]["render"])
+    print(f"\n=== {results[1]['name']}'s replica ===")
+    print(results[1]["render"])
+    if len(texts) == 1:
+        print("\nCONVERGED: both replicas render identical documents")
+        return 0
+    print("\nDIVERGED!")
+    return 1
+
+
 def run_demo() -> int:
     server = subprocess.Popen(
         [sys.executable, "-m", "fluidframework_tpu.service.front_end",
@@ -188,32 +224,7 @@ def run_demo() -> int:
     try:
         line = server.stdout.readline().strip()
         port = int(line.rsplit(":", 1)[1])
-        def spawn(name, s):
-            return subprocess.Popen(
-                [sys.executable, "-m", "examples.shared_text",
-                 "--connect", str(port), "--name", name, "--script", s],
-                stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
-
-        alice = spawn("alice", "a")
-        assert alice.stdout.readline().strip() == "READY"
-        editors = [alice, spawn("bob", "b")]
-        results = []
-        for e in editors:
-            out, _ = e.communicate(timeout=60)
-            if e.returncode != 0:
-                print(f"editor failed rc={e.returncode}")
-                return 1
-            results.append(json.loads(out.strip().splitlines()[-1]))
-        texts = {r["text"] for r in results}
-        print(f"\n=== {results[0]['name']}'s replica ===")
-        print(results[0]["render"])
-        print(f"\n=== {results[1]['name']}'s replica ===")
-        print(results[1]["render"])
-        if len(texts) == 1:
-            print("\nCONVERGED: both replicas render identical documents")
-            return 0
-        print("\nDIVERGED!")
-        return 1
+        return run_clients(port)
     finally:
         server.terminate()
         server.wait(timeout=10)

@@ -1,0 +1,85 @@
+"""Admin CLI: tenant CRUD + per-doc pipeline status over the admin RPCs.
+
+Ref: server/admin (the reference's management portal) and riddler's
+tenantManager REST (routerlicious/src/riddler/tenantManager.ts) — here
+one CLI against the ordering core's admin frames (front_end.py
+``_handle_admin``; gateways relay nothing admin — point this at a core).
+
+    python -m fluidframework_tpu.admin status TENANT DOC --port P
+    python -m fluidframework_tpu.admin docs --port P
+    python -m fluidframework_tpu.admin tenants --port P
+    python -m fluidframework_tpu.admin tenant-add ID SECRET --port P
+    python -m fluidframework_tpu.admin tenant-rm ID --port P
+
+``--admin-secret`` must match the core's ``--admin-secret`` whenever one
+is configured (and always on a tenancy-enforcing deployment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _request(args, frame: dict) -> dict:
+    from .driver.network import _Transport
+
+    if args.admin_secret:
+        frame["secret"] = args.admin_secret
+    t = _Transport(args.host, args.port, timeout=10.0)
+    try:
+        return t.request(frame)
+    finally:
+        t.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="fluid service admin")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--admin-secret", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("status", help="one doc's pipeline status")
+    s.add_argument("tenant")
+    s.add_argument("doc")
+    sub.add_parser("docs", help="list live docs")
+    sub.add_parser("tenants", help="list registered tenants")
+    s = sub.add_parser("tenant-add", help="register a tenant")
+    s.add_argument("id")
+    s.add_argument("secret")
+    s = sub.add_parser("tenant-rm", help="deregister a tenant")
+    s.add_argument("id")
+    args = p.parse_args(argv)
+
+    if args.cmd == "status":
+        reply = _request(args, {"t": "admin_status", "tenant": args.tenant,
+                                "doc": args.doc})
+        if reply.get("status") is None:
+            print(f"no live pipeline for {args.tenant}/{args.doc}")
+            return 1
+        print(json.dumps(reply["status"], indent=2))
+    elif args.cmd == "docs":
+        reply = _request(args, {"t": "admin_docs"})
+        for d in reply["docs"]:
+            print(d)
+    elif args.cmd == "tenants":
+        reply = _request(args, {"t": "admin_tenants"})
+        for tenant in reply["tenants"]:
+            print(tenant)
+    elif args.cmd == "tenant-add":
+        _request(args, {"t": "admin_tenant_add", "id": args.id,
+                        "tenant_secret": args.secret})
+        print(f"registered {args.id}")
+    elif args.cmd == "tenant-rm":
+        reply = _request(args, {"t": "admin_tenant_remove",
+                                "id": args.id})
+        if not reply.get("ok"):
+            print(f"unknown tenant {args.id}")
+            return 1
+        print(f"removed {args.id}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

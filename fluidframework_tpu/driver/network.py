@@ -52,6 +52,7 @@ class _Transport:
         self.on_binary_ops: Optional[Callable[[list], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._closed = False
+        self._idle_windows = 0  # consecutive recv-timeout windows
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True, name="fluid-net-reader")
         self._reader.start()
@@ -90,10 +91,36 @@ class _Transport:
         while len(buf) < n:
             try:
                 chunk = self.sock.recv(n - len(buf))
+            except TimeoutError:
+                # the connect timeout stays on the socket, but a PUSH
+                # connection is legitimately silent for long stretches
+                # (an idle doc; a paused/backlogged pipeline). Treating
+                # the timeout as EOF killed the reader thread after 30 s
+                # of server silence — the client then ignored every
+                # later push (acks, ops) while looking connected: the
+                # round-4 full-composition failure. Idle is not death —
+                # but a VANISHED peer (powered off, partitioned,
+                # SIGSTOPped core) sends no FIN either, so idle windows
+                # escalate: probe with a ping (every terminator — core,
+                # python gateway, native gateway — answers pong/error,
+                # and ANY bytes prove liveness); two unanswered probe
+                # windows in a row mean the peer is gone and the
+                # disconnect path (auto-reconnect/failover) must run.
+                if self._closed:
+                    return None
+                self._idle_windows += 1
+                if self._idle_windows > 2:
+                    return None
+                try:
+                    self.send({"t": "ping"})
+                except OSError:
+                    return None
+                continue
             except (OSError, ValueError):
                 return None
             if not chunk:
                 return None
+            self._idle_windows = 0
             buf += chunk
         return buf
 

@@ -262,6 +262,13 @@ class _ClientSession:
                 self._handle_storage(t, frame, rid)
             elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
                 self._handle_gateway(t, frame, rid)
+            elif t in ("admin_status", "admin_docs", "admin_tenants",
+                       "admin_tenant_add", "admin_tenant_remove"):
+                self._handle_admin(t, frame, rid)
+            elif t == "ping":
+                # client liveness probe on an idle connection (the
+                # driver's recv-timeout escalation, driver/network.py)
+                self.push("pong", {})
             else:
                 raise ValueError(f"unknown frame type {t!r}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
@@ -491,6 +498,79 @@ class _ClientSession:
                 "id": storage.upload_summary(frame["summary"],
                                              frame.get("parent"))})
 
+    def _handle_admin(self, t: str, frame: dict, rid) -> None:
+        """Management surface (ref: server/admin + riddler's
+        tenantManager REST): per-doc pipeline status, doc listing, and
+        tenant CRUD, secured by ``--admin-secret`` whenever one is set
+        (and ALWAYS required once tenancy is enforcing — an open admin
+        door next to secured tenants would be a bypass)."""
+        front = self.front
+        secret = front.admin_secret
+        tenants = front.server.tenants
+        if secret is not None:
+            import hmac as _hmac
+
+            if not _hmac.compare_digest(str(frame.get("secret") or ""),
+                                        secret):
+                raise PermissionError("bad admin secret")
+        elif tenants is not None and tenants.enforcing:
+            raise PermissionError(
+                "admin surface requires --admin-secret on a secured "
+                "deployment")
+        if t == "admin_status":
+            tenant, doc = frame["tenant"], frame["doc"]
+            server = front.server_for(tenant, doc)
+            orderer = server._orderers.get(f"{tenant}/{doc}")
+            if orderer is None:
+                self.push("admin", {"rid": rid, "status": None})
+                return
+            deli = orderer.deli
+            clients = [
+                {"clientId": c.client_id,
+                 "clientSeq": c.client_sequence_number,
+                 "refSeq": c.reference_sequence_number}
+                for c in deli.clients.values()]
+            msn = min((c.reference_sequence_number
+                       for c in deli.clients.values()),
+                      default=deli.sequence_number)
+            self.push("admin", {"rid": rid, "status": {
+                "tenant": tenant, "doc": doc,
+                "seq": deli.sequence_number,
+                "msn": msn,
+                "clients": clients,
+                "summaryHead": orderer.scribe.last_summary_head,
+                "retainedBase": orderer.scriptorium.retained_base(
+                    tenant, doc),
+                "applierSeq": front.applier_status.get((tenant, doc)),
+            }})
+        elif t == "admin_docs":
+            docs = []
+            for server in front._all_servers():
+                docs.extend(sorted(server._orderers))
+            self.push("admin", {"rid": rid, "docs": docs})
+        elif t == "admin_tenants":
+            self.push("admin", {
+                "rid": rid,
+                "tenants": tenants.list_tenants() if tenants else []})
+        elif t == "admin_tenant_add":
+            if tenants is None:
+                from .tenants import TenantManager
+
+                tenants = front.server.tenants = TenantManager()
+                for server in front._all_servers():
+                    server.tenants = tenants
+            tenants.register(frame["id"], frame["tenant_secret"])
+            if front.shard_host is not None:
+                # deployment-wide: the other cores reload the registry
+                # file on their next lease poll
+                front.shard_host.save_tenants()
+            self.push("admin", {"rid": rid, "ok": True})
+        elif t == "admin_tenant_remove":
+            ok = tenants.remove(frame["id"]) if tenants else False
+            if ok and front.shard_host is not None:
+                front.shard_host.save_tenants()
+            self.push("admin", {"rid": rid, "ok": ok})
+
     def _unsubscribe_ftopic(self, topic: str) -> None:
         entry = self._ftopics.pop(topic, None)
         if entry is not None:
@@ -567,6 +647,21 @@ class ShardHost:
             os.path.join(shard_dir, "placement"), n,
             ttl_s if ttl_s is not None else DEFAULT_TTL_S)
         self.servers: dict[int, LocalServer] = {}
+        # ONE TenantManager shared by every partition server of this
+        # process (including ones claimed later by takeover), kept in
+        # sync with the DEPLOYMENT-WIDE registry file
+        # <shard_dir>/tenants.json: admin tenant-add on any core secures
+        # every core — other processes pick the file up on their next
+        # lease poll, and a core started later loads it at boot. A
+        # late-claimed or freshly-started tenant-less server would
+        # otherwise silently accept unsigned connects (riddler's
+        # tenantManager role, but file-backed like the leases).
+        from .tenants import TenantManager
+
+        self.tenants = TenantManager()
+        self._tenants_path = os.path.join(shard_dir, "tenants.json")
+        self._tenants_mtime = None
+        self._reload_tenants()
         self._start_t = None
         # monotonic time of the last CONFIRMED lease per partition (the
         # fencing clock — see _make_server)
@@ -583,7 +678,8 @@ class ShardHost:
         from .durable_log import DurableLog
 
         log = DurableLog(os.path.join(self.shard_dir, f"log-{k}"))
-        server = LocalServer(log=log, storage_server=self.storage_server)
+        server = LocalServer(log=log, storage_server=self.storage_server,
+                             tenants=self.tenants)
         # lease fencing: orders are refused unless the lease was
         # confirmed within 75% of the TTL — a stalled-and-resumed
         # process fails this check on its first buffered frame, before
@@ -594,10 +690,43 @@ class ShardHost:
             time.monotonic() - self.hb_times.get(k, 0.0) < margin)
         return server
 
+    def _reload_tenants(self) -> None:
+        import json
+        import os
+
+        try:
+            mtime = os.stat(self._tenants_path).st_mtime_ns
+        except OSError:
+            return
+        if mtime == self._tenants_mtime:
+            return
+        self._tenants_mtime = mtime
+        try:
+            with open(self._tenants_path) as f:
+                self.tenants.replace_all(json.load(f))
+        except (OSError, ValueError):
+            pass  # mid-replace race: next poll rereads
+
+    def save_tenants(self) -> None:
+        """Persist the registry for the OTHER cores (atomic replace)."""
+        import json
+        import os
+
+        tmp = self._tenants_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.tenants._secrets, f)
+        os.replace(tmp, self._tenants_path)
+        try:
+            self._tenants_mtime = os.stat(
+                self._tenants_path).st_mtime_ns
+        except OSError:
+            pass
+
     def poll(self) -> None:
         """Heartbeat owned partitions; claim unowned/stale ones."""
         import time
 
+        self._reload_tenants()
         if self._start_t is None:
             self._start_t = time.monotonic()
         for k in list(self.servers):
@@ -647,10 +776,15 @@ class NetworkFrontEnd:
     def __init__(self, server: Optional[LocalServer] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  max_message_size: Optional[int] = None,
-                 shard_host: Optional[ShardHost] = None):
+                 shard_host: Optional[ShardHost] = None,
+                 admin_secret: Optional[str] = None):
         self.shard_host = shard_host
+        self.admin_secret = admin_secret
         if shard_host is not None:
-            server = LocalServer()  # config/tenants shell; never serves
+            # config/tenants shell; never serves. Shares the shard
+            # host's deployment-wide tenant registry so the admin
+            # surface and enforcement checks see the same state.
+            server = LocalServer(tenants=shard_host.tenants)
         self.server = server if server is not None else LocalServer()
         self.logger = self.server.logger.child("front_end")
         self.host = host
@@ -670,6 +804,7 @@ class NetworkFrontEnd:
         # logs this core consumes, and whether the shared log needs
         # visibility flushes for external consumers
         self._backchannels: list = []
+        self._bg_tasks: list = []  # strong refs; the loop's are weak
         self._log_flush = (shard_host is not None
                            or hasattr(self.server.log, "flush"))
         # (tenant, doc) → applied seq reported by an applier stage
@@ -780,8 +915,17 @@ class NetworkFrontEnd:
         while True:
             moved = False
             for bc in self._backchannels:
-                if bc.poll():
-                    bc.drain()
+                try:
+                    if bc.poll():
+                        bc.drain()
+                        moved = True
+                except Exception as e:  # noqa: BLE001
+                    # drain advances the cursor BEFORE the handler runs,
+                    # so continuing resumes at the NEXT record — one
+                    # poisoned record must not kill every stage's
+                    # pipeline (it used to: the task died silently)
+                    self.logger.error("backchannel_record_error",
+                                      message=str(e))
                     moved = True
             if moved and self._log_flush:
                 # acks ordered above must become visible to the stages
@@ -796,8 +940,12 @@ class NetworkFrontEnd:
             self._handle_conn, self.host, self.port, backlog=1024)
         self.port = self._aio_server.sockets[0].getsockname()[1]
         if self._backchannels:
-            asyncio.get_running_loop().create_task(
-                self._poll_backchannels())
+            # the loop holds only a WEAK ref to tasks: an unreferenced
+            # poller is garbage-collected at an arbitrary gc cycle and
+            # backchannel consumption silently stops (the round-4
+            # full-composition failure — summary acks never returned)
+            self._bg_tasks.append(asyncio.get_running_loop().create_task(
+                self._poll_backchannels()))
         if self.shard_host is not None:
             loop = asyncio.get_running_loop()
 
@@ -822,7 +970,7 @@ class NetworkFrontEnd:
                     except Exception as e:  # noqa: BLE001
                         self.logger.error("lease_poll_error",
                                           message=str(e))
-            loop.create_task(lease_loop())
+            self._bg_tasks.append(loop.create_task(lease_loop()))
         self._ready.set()
 
     def start_background(self) -> "NetworkFrontEnd":
@@ -925,6 +1073,9 @@ def main() -> None:
                              "only by stale-lease takeover)")
     parser.add_argument("--lease-ttl", type=float, default=None,
                         help="lease staleness threshold in seconds")
+    parser.add_argument("--admin-secret", default=None,
+                        help="shared secret gating the admin RPCs "
+                             "(required when tenancy is enforcing)")
     args = parser.parse_args()
     if args.shard_dir is not None:
         import gc as _gc
@@ -951,7 +1102,8 @@ def main() -> None:
         _gc.disable()
         front = NetworkFrontEnd(host=args.host, port=args.port,
                                 max_message_size=args.max_message_size,
-                                shard_host=shard_host)
+                                shard_host=shard_host,
+                                admin_secret=args.admin_secret)
         front.serve_forever()
         return
     server = None
@@ -994,7 +1146,8 @@ def main() -> None:
     gc.disable()
 
     front = NetworkFrontEnd(server=server, host=args.host, port=args.port,
-                            max_message_size=args.max_message_size)
+                            max_message_size=args.max_message_size,
+                            admin_secret=args.admin_secret)
     for state_dir in args.consume_backchannel:
         front.attach_backchannel(state_dir)
     front.serve_forever()

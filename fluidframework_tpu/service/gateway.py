@@ -131,6 +131,10 @@ class _GatewaySession:
                              self.up)
         elif t == "disconnect":
             self.detach()
+        elif t == "ping":
+            # answered HERE, not relayed: the probe checks this hop's
+            # liveness, and the upstream has its own reader watchdog
+            self.push({"t": "pong"})
         elif t in ("get_deltas", "get_versions", "get_tree", "read_blob",
                    "write_blob", "upload_summary"):
             up = await gw.upstream_for(frame["tenant"], frame["doc"])
@@ -214,7 +218,10 @@ class Gateway:
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         up = _Upstream(self, address, writer)
         self._upstreams[address] = up
-        asyncio.get_running_loop().create_task(
+        # keep a strong ref on the upstream: the loop's refs are weak,
+        # and a gc'd reader task silently freezes every session on this
+        # core (acks stop; clients stall until reconnect)
+        up.reader_task = asyncio.get_running_loop().create_task(
             self._upstream_loop(reader, up))
         return up
 

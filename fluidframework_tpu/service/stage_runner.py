@@ -119,15 +119,70 @@ class _StageHostBase:
                     if self._owns(topic):
                         self.attach(topic)
 
+    # -------------------------------------------------- deterministic ctl
+    # Cross-process stepping (VERDICT r4 #9 — the OpProcessingController
+    # role, opProcessingController.ts:16, extended across the process
+    # boundary): a controller writes ``<state_dir>/ctl.json`` with
+    # {"mode": "pause"|"run", "steps": N} and this stage consumes AT
+    # MOST N records total while paused — so a composition bug
+    # reproduces op-by-op, each step observable through the backchannel.
+
+    def _read_ctl(self) -> None:
+        import json
+        import os
+
+        path = os.path.join(self.state.directory, "ctl.json")
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return
+        if mtime == self._ctl_mtime:
+            return
+        self._ctl_mtime = mtime
+        try:
+            with open(path) as f:
+                self._ctl = json.load(f)
+        except (OSError, ValueError):
+            pass
+
+    def _step_once(self) -> bool:
+        """Deliver exactly ONE pending record (first lagging topic in
+        subscription order). Returns False when fully drained."""
+        for topic in list(self.shared._order):
+            if self.shared.step(topic):
+                return True
+        return False
+
     def run_forever(self) -> None:
         print("READY", flush=True)
         last_discover = 0.0
+        self._ctl = {"mode": "run"}
+        self._ctl_mtime = None
+        self._steps_done = 0
         while True:
             now = time.monotonic()
             if now - last_discover >= 0.25:  # listdir is not free at 2ms
                 last_discover = now
                 self.discover()
+                self._read_ctl()
             moved = self.shared.poll()
+            if self._ctl.get("mode") != "pause":
+                # leaving (or never entering) a pause episode resets the
+                # step ledger: each pause session's budget counts from 0,
+                # not from the lifetime total of earlier sessions
+                self._steps_done = 0
+            if self._ctl.get("mode") == "pause":
+                self._read_ctl()
+                budget = int(self._ctl.get("steps", 0))
+                stepped = False
+                while self._steps_done < budget and self._step_once():
+                    self._steps_done += 1
+                    stepped = True
+                if stepped:
+                    self.checkpoint()
+                    self.state.flush()
+                time.sleep(0.01)
+                continue
             if moved:
                 self.shared.drain()
             now = time.monotonic()

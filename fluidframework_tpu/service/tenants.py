@@ -69,6 +69,19 @@ class TenantManager:
     def register(self, tenant_id: str, secret: str) -> None:
         self._secrets[tenant_id] = secret
 
+    def remove(self, tenant_id: str) -> bool:
+        """Deregister a tenant; its tokens stop validating immediately."""
+        return self._secrets.pop(tenant_id, None) is not None
+
+    def list_tenants(self) -> list[str]:
+        return sorted(self._secrets)
+
+    def replace_all(self, secrets: dict) -> None:
+        """Swap the whole registry in place (shared-registry reload:
+        every server holding this instance sees the change at once)."""
+        self._secrets.clear()
+        self._secrets.update(secrets)
+
     @property
     def enforcing(self) -> bool:
         return bool(self._secrets)

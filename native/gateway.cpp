@@ -616,6 +616,10 @@ void handle_client_json(Gateway* g, Session* s, const char* body, size_t len) {
     send_upstream(g, make_frame(out));
   } else if (t == "disconnect") {
     detach_session(g, s, true);
+  } else if (t == "ping") {
+    // client liveness probe: answered at this hop (driver/network.py
+    // recv-timeout escalation), never relayed upstream
+    send_to(g, s, make_frame("{\"t\":\"pong\"}"));
   } else if (t == "get_deltas" || t == "get_versions" || t == "get_tree" ||
              t == "read_blob" || t == "write_blob" || t == "upload_summary") {
     long long grid = g->next_rid++;

@@ -1,0 +1,124 @@
+"""Admin surface + dev-host runner + the round-5 example apps.
+
+Ref: server/admin + riddler tenantManager (management surface),
+webpack-fluid-loader multiResolver.ts:75 (the dev host),
+examples/data-objects/{todo,canvas} (the apps).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+
+
+def _spawn(args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+def _admin(port, *argv):
+    from fluidframework_tpu import admin
+
+    return admin.main(["--port", str(port), *argv])
+
+
+def test_admin_status_docs_and_tenant_crud(capsys):
+    core, port = _spawn(["fluidframework_tpu.service.front_end",
+                         "--port", "0", "--admin-secret", "s3s4m3"])
+    try:
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c = loader.resolve("t", "admindoc")
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "hello")
+        t0 = time.time()
+        while c.runtime.pending.count > 0 and time.time() - t0 < 10:
+            time.sleep(0.02)
+
+        args = ("--admin-secret", "s3s4m3")
+        assert _admin(port, *args, "status", "t", "admindoc") == 0
+        out = capsys.readouterr().out
+        import json
+
+        status = json.loads(out)
+        assert status["seq"] >= 2  # join + at least the insert
+        assert status["clients"] and \
+            status["clients"][0]["clientId"] == c.client_id
+        assert status["msn"] <= status["seq"]
+
+        assert _admin(port, *args, "docs") == 0
+        assert "t/admindoc" in capsys.readouterr().out
+
+        # a wrong secret is refused
+        with pytest.raises(RuntimeError):
+            _admin(port, "--admin-secret", "wrong", "docs")
+
+        # tenant CRUD round-trip
+        assert _admin(port, *args, "tenant-add", "acme", "shh") == 0
+        capsys.readouterr()
+        assert _admin(port, *args, "tenants") == 0
+        assert "acme" in capsys.readouterr().out
+        # tenancy is now enforcing: an unsigned connect is refused
+        from fluidframework_tpu.service.tenants import AuthError, sign_token
+
+        with pytest.raises(RuntimeError):
+            loader.resolve("acme", "secured")
+        signed = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", port,
+            token_provider=lambda t, d: sign_token(t, d, "shh")))
+        c2 = signed.resolve("acme", "secured")
+        assert c2.connected
+        assert _admin(port, *args, "tenant-rm", "acme") == 0
+        assert _admin(port, *args, "tenant-rm", "acme") == 1
+    finally:
+        core.terminate()
+        core.wait(timeout=10)
+
+
+def test_admin_requires_secret_on_secured_deployment():
+    core, port = _spawn(["fluidframework_tpu.service.front_end",
+                         "--port", "0", "--tenant", "acme:shh"])
+    try:
+        with pytest.raises(RuntimeError):
+            _admin(port, "docs")
+    finally:
+        core.terminate()
+        core.wait(timeout=10)
+
+
+@pytest.mark.parametrize("app", ["todo", "canvas"])
+def test_example_demo_converges(app):
+    out = subprocess.run(
+        [sys.executable, "-m", f"examples.{app}"],
+        capture_output=True, text=True, timeout=240, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED" in out.stdout
+
+
+def test_dev_host_runs_app_on_gateway_topology():
+    out = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.host", "todo",
+         "-t", "gateway"],
+        capture_output=True, text=True, timeout=240, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED" in out.stdout
+
+
+def test_dev_host_runs_app_on_sharded_topology():
+    out = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.host", "canvas",
+         "-t", "sharded"],
+        capture_output=True, text=True, timeout=240, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CONVERGED" in out.stdout

@@ -328,3 +328,66 @@ def test_snapshot_cache_second_boot_issues_no_storage_rpcs(front_end):
     assert c4.storage.rpcs > 0  # refetched the newer version
     assert wait_for(lambda: c4.runtime.get_data_store("default")
                     .get_channel("text").get_text() == "fresh cache me")
+
+
+def test_idle_connection_survives_recv_timeout_windows(front_end):
+    """A silent server is NOT a dead server: with a short recv timeout,
+    an idle client's reader must ride through several timeout windows
+    (probing with pings) and still deliver a push that arrives much
+    later. Regression: the reader thread used to treat the recv timeout
+    as EOF and die silently after 30 s of server silence — after which
+    summary acks/ops pushed by the server were ignored forever (the
+    round-4 full-composition failure mode)."""
+    loader = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", front_end.port, timeout=1.0))
+    c1 = loader.resolve("t", "idledoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "x")
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    # idle well past 2 recv-timeout windows (the escalation budget)
+    time.sleep(3.5)
+    # a second client edits; the idle client must still receive it
+    c2 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", front_end.port)).resolve("t", "idledoc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(1, "y")
+    assert wait_for(lambda: s1.get_text() == "xy", timeout=20.0), \
+        f"idle client missed the push: {s1.get_text()!r}"
+
+
+def test_vanished_server_detected_by_ping_escalation():
+    """A VANISHED peer (SIGSTOPped server: TCP keeps ACKing, no FIN
+    ever) must be detected: unanswered ping probes over consecutive
+    idle windows end the reader and fire on_disconnect, which is what
+    lets auto-reconnect/sharded failover take over."""
+    import signal as _signal
+
+    from fluidframework_tpu.driver.network import _Transport
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    try:
+        line = proc.stdout.readline().strip()
+        port = int(line.rsplit(":", 1)[1])
+        t = _Transport("127.0.0.1", port, timeout=1.0)
+        reasons = []
+        t.on_disconnect = reasons.append
+        # prove liveness first: a ping gets answered while running
+        t.send({"t": "ping"})
+        time.sleep(1.0)
+        assert not reasons
+        proc.send_signal(_signal.SIGSTOP)
+        try:
+            # ~2 idle windows + margin: reader must give up and report
+            assert wait_for(lambda: reasons, timeout=15.0), \
+                "vanished server never detected"
+        finally:
+            proc.send_signal(_signal.SIGCONT)
+        t.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -240,3 +240,60 @@ def test_lease_registry_claim_heartbeat_takeover(tmp_path):
     # release clears the file
     pd.release(0, "b")
     assert pd.owner_of(0) is None
+
+
+def test_admin_tenant_add_secures_partitions_claimed_later(tmp_path):
+    """admin tenant-add on a sharded core must secure docs in partitions
+    this core claims LATER by lease takeover too — a tenant-less
+    late-claimed LocalServer would silently accept unsigned connects
+    (the bypass _handle_admin's docstring promises can't happen)."""
+    from fluidframework_tpu import admin
+    from fluidframework_tpu.service.tenants import sign_token
+
+    shard_dir = tmp_path / "deploy"
+    procs = []
+    try:
+        core0, p0 = _core(tmp_path, shard_dir, "0")
+        procs.append(core0)
+        core1, p1 = _core(tmp_path, shard_dir, "1")
+        procs.append(core1)
+
+        # register the tenant on core1 (which owns only partition 1 now)
+        assert admin.main(["--port", str(p1), "tenant-add",
+                           "acme", "shh"]) == 0
+
+        by_part = _docs_for_both_partitions(n_each=1)
+        d0 = by_part[0][0]  # partition core1 does NOT own yet
+
+        # CROSS-PROCESS propagation: core0 (a different OS process that
+        # never saw the admin call) reloads the deployment-wide registry
+        # on its next lease poll and refuses unsigned connects too
+        time.sleep(1.5)  # > ttl/3 poll cadence
+        unsigned0 = Loader(NetworkDocumentServiceFactory("127.0.0.1", p0))
+        with pytest.raises(RuntimeError):
+            unsigned0.resolve("acme", d0)
+
+        # kill core0; core1 claims partition 0 after the TTL
+        os.kill(core0.pid, signal.SIGKILL)
+        core0.wait(timeout=10)
+        time.sleep(float(TTL) + 1.0)
+
+        # an unsigned connect to the late-claimed partition is refused
+        unsigned = Loader(NetworkDocumentServiceFactory("127.0.0.1", p1))
+        with pytest.raises(RuntimeError):
+            unsigned.resolve("acme", d0)
+
+        # a signed one works
+        signed = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", p1,
+            token_provider=lambda t, d: sign_token(t, d, "shh")))
+        c = signed.resolve("acme", d0)
+        assert c.connected
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

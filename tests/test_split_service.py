@@ -287,6 +287,91 @@ def test_doc_partitioned_appliers_and_rebalance(tmp_path):
         _cleanup(appliers + [core])
 
 
+def _set_ctl(state_dir, mode: str, steps: int) -> None:
+    import json as _json
+
+    os.makedirs(state_dir, exist_ok=True)
+    tmp = str(state_dir) + ".ctltmp"
+    with open(tmp, "w") as f:
+        _json.dump({"mode": mode, "steps": steps}, f)
+    os.replace(tmp, os.path.join(state_dir, "ctl.json"))
+
+
+def test_cross_process_deterministic_stepping(tmp_path):
+    """Drive the scribe PROCESS one record at a time (VERDICT r4 #9 —
+    opProcessingController.ts:16 across the process boundary): with the
+    stage paused the summary is never acked even though the core is
+    live; stepping releases exactly one log record per step, and the
+    ack appears at one specific step boundary (the SUMMARIZE record's),
+    never before."""
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+    from fluidframework_tpu.service.durable_log import DurableLog
+
+    # pause the stage BEFORE it starts: the whole stream is stepped
+    scribe_state = tmp_path / "scribe-state"
+    _set_ctl(scribe_state, "pause", 0)
+
+    with split_deployment(tmp_path, stages=("scribe",)) as (
+            port, _, state_dirs, log_dir):
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c1 = loader.resolve("t", "doc")
+        sm = SummaryManager(c1, max_ops=3)
+        s = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "abcdef")
+        s.remove_text(0, 2)
+        # the summarize op is in flight...
+        assert wait_for(lambda: sm._pending_handle is not None)
+        # ...but the paused validator never acks it
+        time.sleep(2.0)
+        assert sm.summaries_acked == 0
+
+        # step the stage record by record; the ack must land at exactly
+        # one boundary and stay monotonic. Per step, wait on the stage's
+        # own observables — its post-step checkpoint (cp topic) and
+        # backchannel emissions — instead of sleeping a fixed window
+        # (a blind 5 s x ~7 pre-ack steps was ~35 s of pure sleep).
+        state_view = DurableLog(str(scribe_state), readonly=True)
+        try:
+            last_cp = state_view.refresh_topic("cp/t/doc")
+            last_bc = state_view.refresh_topic("backchannel")
+            acked_at = None
+            for step in range(1, 200):
+                _set_ctl(scribe_state, "pause", step)
+                t0 = time.time()
+                while time.time() - t0 < 10.0 and sm.summaries_acked == 0:
+                    cp = state_view.refresh_topic("cp/t/doc")
+                    if cp > last_cp:
+                        last_cp = cp
+                        break  # stage consumed this step's budget
+                    time.sleep(0.02)
+                bc = state_view.refresh_topic("backchannel")
+                if bc > last_bc:
+                    # the stage emitted (ack/version) this step: give the
+                    # core's backchannel poll the window to relay it
+                    last_bc = bc
+                    t1 = time.time()
+                    while time.time() - t1 < 10.0 \
+                            and sm.summaries_acked == 0:
+                        time.sleep(0.02)
+                if sm.summaries_acked >= 1:
+                    acked_at = step
+                    break
+        finally:
+            state_view.close()
+        assert acked_at is not None, "stepping never released the ack"
+        # the stream up to the summarize spans several records (joins,
+        # the two edits, the upload announcement, the summarize): the
+        # ack cannot have been released by the first step
+        shared = DurableLog(str(log_dir), readonly=True)
+        try:
+            n_deltas = shared.refresh_topic("deltas/t/doc")
+        finally:
+            shared.close()
+        assert acked_at > 1
+        assert n_deltas >= acked_at - 1  # steps consumed real records
+
+
 def test_full_production_composition(tmp_path):
     """EVERY tier at once, each its own OS process: storage server
     (commit/ref DAG), ordering core over the durable log with an
