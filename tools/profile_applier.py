@@ -75,8 +75,7 @@ def main() -> None:
     # ---- pack only: _dispatch_wave minus the device calls ----
     # re-measure by timing the numpy assembly on a staged wave
     stage_full_wave(2 + (T + 1) * K)
-    with app._lock:
-        parts = app._take_wave_locked()
+    parts = app._take_wave_locked()  # sync mode: no worker, no lock
     all_chunks, slots, lens = [], [], []
     for slot, chunks, count in parts:
         if count:
