@@ -279,6 +279,7 @@ def bench_network() -> dict:
             for w in range(nworkers)
         ]
         lats, ops, acked, secs, errors = [], 0, 0, 0.0, []
+        late = 0.0
         hops = {"submit_to_deli": [], "deli_to_ack": []}
         for w in workers:
             out, _ = w.communicate(timeout=timeout)
@@ -287,6 +288,7 @@ def bench_network() -> dict:
             ops += r["ops"]
             acked += r["acked"]
             secs = max(secs, r["seconds"])
+            late = max(late, r.get("late_s", 0.0))
             errors.extend(r.get("errors", []))
             for k in hops:
                 hops[k].extend(r["hops"].get(k, []))
@@ -301,6 +303,10 @@ def bench_network() -> dict:
             "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
             "p50_ack_ms": pct(lats, 0.50),
             "p99_ack_ms": pct(lats, 0.99),
+            # a worker that finished connecting AFTER the synchronized
+            # start measured the join storm, not steady load: the trial
+            # is tainted and the caller should retry with a wider margin
+            "late_s": late,
             "hops": {name: {"p50_ms": pct(v, 0.50), "p99_ms": pct(v, 0.99)}
                      for name, v in hops.items()},
         }
@@ -373,25 +379,100 @@ def bench_network() -> dict:
         # published p99 field is the saturation marker. ----
         cfg4 = None
         for rate in (0.15, 0.125, 0.1, 0.075, 0.05, 0.035):
-            for attempt in ("", "b"):  # one retry per rate: a single
-                # co-tenant burst inside a 30 s window poisons the p99
+            for attempt, margin in (("", 40.0), ("b", 110.0)):
+                # one retry per rate, at a much wider start margin: a
+                # co-tenant burst during the 10k-connection phase makes
+                # workers START LATE (late_s > 0), and a late trial
+                # measures the join storm riding into the load window —
+                # the dominant cause of the multi-second cfg4 p99 tails
                 cfg4 = run_workers(gw_ports, 4, 250, 10, rate, 8, 3,
                                    f"cfg4r{rate}{attempt}",
-                                   start_margin=40.0, timeout=420.0)
-                if cfg4["p99_ack_ms"] < 50.0:
+                                   start_margin=margin, timeout=420.0)
+                if cfg4["p99_ack_ms"] < 50.0 and cfg4["late_s"] == 0:
                     break
-            if cfg4["p99_ack_ms"] < 50.0:
+            if cfg4["p99_ack_ms"] < 50.0 and cfg4["late_s"] == 0:
                 break
+        # the single-core tier is torn down — and WAITED on — before the
+        # sharded run: 4 gateways dropping 10k sockets spend seconds in
+        # teardown, and that CPU must not bleed into the sharded trial
+        for gw, _ in gws:
+            gw.terminate()
+        for gw, _ in gws:
+            try:
+                gw.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                gw.kill()
+        gws = []
+        fe.terminate()
+        fe.wait(timeout=10)
+        fe = None
+
+        sharded = bench_sharded(best["rate_hz"], run_workers)
         return {
             "knee": best,
             "direct": direct,
             "cfg4": cfg4,
+            "sharded": sharded,
         }
     finally:
         for gw, _ in gws:
             gw.terminate()
-        fe.terminate()
-        fe.wait(timeout=10)
+        if fe is not None:
+            fe.terminate()
+            fe.wait(timeout=10)
+
+
+def bench_sharded(knee_rate: float, run_workers) -> dict:
+    """The SHARDED ordering core at the knee geometry (VERDICT r4 #4):
+    2 core processes over placement leases, gateways routing by doc
+    partition. On a MULTI-core host this row is the sequencer scaling
+    out (target ≥1.5× the 1-core knee); this bench host has ONE CPU
+    (nproc=1), where two core processes can only time-slice it — the
+    row is published for the posture's honesty (mechanism correctness
+    is tests/test_sharded_core.py), and the ladder tops at 1.5×."""
+    import tempfile
+
+    shard_dir = tempfile.mkdtemp(prefix="bench-shard-")
+    cores = []
+    gws = []
+    try:
+        for prefer in ("0", "1"):
+            c, _ = _spawn_listening(
+                "fluidframework_tpu.service.front_end", "--port", "0",
+                "--shard-dir", shard_dir, "--shards", "2",
+                "--prefer", prefer)
+            cores.append(c)
+        for _ in range(2):
+            gw, gp = _spawn_listening(
+                "fluidframework_tpu.service.gateway", "--shard-dir",
+                shard_dir, "--shards", "2")
+            gws.append((gw, gp))
+        ports = [p for _, p in gws]
+        run_workers(ports, 2, 8, 2, 2.0, 8, 4, "swarm", start_margin=3.0)
+        last = None
+        for mult in (1.5, 1.0, 0.75):
+            rate = round(knee_rate * mult, 3)
+            try:
+                r = run_workers(ports, 4, 64, 2, rate, 32,
+                                max(8, int(8 * rate)), f"sh{rate}")
+            except AssertionError:
+                # rung drowned outright (acks never completed before the
+                # workers' wait budget): on a 1-CPU host two time-sliced
+                # cores saturate below the 1-core knee — step down
+                last = {"rate_hz": rate, "ops_per_sec": 0.0,
+                        "p50_ack_ms": None, "p99_ack_ms": None,
+                        "late_s": None, "drowned": True}
+                continue
+            last = r
+            if r["p99_ack_ms"] < 50.0:
+                return r
+        return last
+    finally:
+        for gw, _ in gws:
+            gw.terminate()
+        for c in cores:
+            c.terminate()
+            c.wait(timeout=10)
 
 
 def main() -> None:
@@ -443,6 +524,13 @@ def main() -> None:
                 "net_ops_per_sec_1k_docs": net["cfg4"]["ops_per_sec"],
                 "net_p50_ack_ms_1k_docs": net["cfg4"]["p50_ack_ms"],
                 "net_p99_ack_ms_1k_docs": net["cfg4"]["p99_ack_ms"],
+                # 2-core SHARDED ordering core at the knee geometry
+                # (VERDICT r4 #4: the sequencer scales out; target
+                # >= 1.5x the 1-core knee)
+                "net_sharded_2core_ops_per_sec":
+                    net["sharded"]["ops_per_sec"],
+                "net_sharded_2core_p99_ack_ms":
+                    net["sharded"]["p99_ack_ms"],
             }
         )
     )

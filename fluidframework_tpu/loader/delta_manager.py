@@ -40,6 +40,7 @@ class DeltaManager:
         self.user_disconnected = False
         self.client_id: Optional[str] = None
         self.last_processed_seq = 0
+        self.duplicates_received = 0
         self.minimum_sequence_number = 0
         self._client_seq = 0
         self._reorder: dict[int, SequencedDocumentMessage] = {}
@@ -277,7 +278,12 @@ class DeltaManager:
             self._pause_buffer.append(msg)
             return
         if msg.sequence_number <= self.last_processed_seq:
-            return  # duplicate
+            # dedupe is correctness (reconnect backfill overlap), but a
+            # STEADY duplicate stream is a delivery bug upstream (e.g.
+            # the gateway double-upstream race) that dedupe would mask —
+            # count it so tests and telemetry can see it
+            self.duplicates_received += 1
+            return
         self._reorder[msg.sequence_number] = msg
         self._drain_reorder()
         if self._reorder:

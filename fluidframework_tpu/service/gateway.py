@@ -202,28 +202,52 @@ class Gateway:
             self.placement = PlacementDir(
                 os.path.join(shard_dir, "placement"), shards)
         self._upstreams: dict[str, _Upstream] = {}
+        self._upstream_dials: dict[str, "asyncio.Future"] = {}
         self._up_default: Optional[_Upstream] = None
 
     # ----------------------------------------------------------- upstream
 
     async def _open_upstream(self, address: str) -> _Upstream:
-        up = self._upstreams.get(address)
-        if up is not None and not up.writer.is_closing():
+        while True:
+            up = self._upstreams.get(address)
+            if up is not None and not up.writer.is_closing():
+                return up
+            dial = self._upstream_dials.get(address)
+            if dial is None:
+                break
+            # another session is already dialing this core: share its
+            # connection. Two concurrent dials would open TWO backbone
+            # connections to one core — the core tracks its per-topic
+            # fan-out subscription per connection, so every broadcast
+            # would reach this gateway (and its clients) TWICE.
+            up = await asyncio.shield(dial)
+            if up is not None and not up.writer.is_closing():
+                return up
+        fut = asyncio.get_running_loop().create_future()
+        self._upstream_dials[address] = fut
+        try:
+            host, _, port = address.rpartition(":")
+            reader, writer = await asyncio.open_connection(
+                host or "127.0.0.1", int(port))
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            up = _Upstream(self, address, writer)
+            self._upstreams[address] = up
+            # keep a strong ref on the upstream: the loop's refs are
+            # weak, and a gc'd reader task silently freezes every
+            # session on this core (acks stop; clients stall until
+            # reconnect)
+            up.reader_task = asyncio.get_running_loop().create_task(
+                self._upstream_loop(reader, up))
+            fut.set_result(up)
             return up
-        host, _, port = address.rpartition(":")
-        reader, writer = await asyncio.open_connection(
-            host or "127.0.0.1", int(port))
-        sock = writer.get_extra_info("socket")
-        if sock is not None:
-            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        up = _Upstream(self, address, writer)
-        self._upstreams[address] = up
-        # keep a strong ref on the upstream: the loop's refs are weak,
-        # and a gc'd reader task silently freezes every session on this
-        # core (acks stop; clients stall until reconnect)
-        up.reader_task = asyncio.get_running_loop().create_task(
-            self._upstream_loop(reader, up))
-        return up
+        finally:
+            del self._upstream_dials[address]
+            if not fut.done():
+                # dial failed: waiters retry (and dial themselves);
+                # the failure propagates to THIS caller via the raise
+                fut.set_result(None)
 
     async def _connect_upstream(self) -> None:
         if self.placement is None:

@@ -182,13 +182,19 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
     await asyncio.gather(*(staged_connect(c) for c in clients))
     readers = [asyncio.ensure_future(c.read_loop()) for c in clients]
 
+    late_s = 0.0
     if start_at is not None:
         # cross-worker synchronized start: the orchestrator hands every
         # worker the same wall-clock instant so no worker's trial runs
-        # against another worker's connect storm
+        # against another worker's connect storm. If connects overran
+        # the margin, the trial is TAINTED (it measures the join storm,
+        # not steady load) — report how late so the orchestrator can
+        # retry with a wider margin instead of publishing the taint.
         delay = start_at - time.time()
         if delay > 0:
             await asyncio.sleep(delay)
+        else:
+            late_s = -delay
     t0 = time.perf_counter()
     await asyncio.gather(*(c.run_rounds(t0, rate_hz) for c in clients))
     expected = sum(c.submitted for c in clients)
@@ -216,6 +222,7 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
         "lat_ms": lat,
         "hops": hops,
         "errors": [c.error for c in clients if c.error],
+        "late_s": round(late_s, 1),
     }
 
 

@@ -297,3 +297,62 @@ def test_admin_tenant_add_secures_partitions_claimed_later(tmp_path):
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+def test_concurrent_connects_no_duplicate_broadcasts(tmp_path):
+    """Two clients of one doc connecting CONCURRENTLY through a fresh
+    gateway must not double every broadcast. Regression: the gateway's
+    lazy per-core dial raced — both connects opened their own backbone
+    connection to the owning core, the core fan-out subscribes per
+    connection, and every batch reached each client twice (real clients
+    masked it by seq dedupe; load tests saw acked == 2x ops)."""
+    import threading
+
+    shard_dir = tmp_path / "deploy"
+    procs = []
+    try:
+        procs.append(_core(tmp_path, shard_dir, "0")[0])
+        procs.append(_core(tmp_path, shard_dir, "1")[0])
+        gw, gport = _spawn(
+            ["fluidframework_tpu.service.gateway", "--shard-dir",
+             str(shard_dir), "--shards", "2"], tmp_path)
+        procs.append(gw)
+
+        d0 = _docs_for_both_partitions(n_each=1)[0][0]
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", gport))
+        results = [None, None]
+
+        def resolve(i):
+            results[i] = loader.resolve("t", d0)
+
+        threads = [threading.Thread(target=resolve, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        c1, c2 = results
+        assert c1 is not None and c2 is not None
+
+        s1 = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        for i in range(10):
+            s1.insert_text(0, f"x{i}")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        assert wait_for(
+            lambda: c2.runtime.data_stores and "text" in
+            c2.runtime.get_data_store("default").channels and
+            c2.runtime.get_data_store("default").get_channel(
+                "text").get_text() == s1.get_text())
+        assert c1.delta_manager.duplicates_received == 0, \
+            f"c1 saw {c1.delta_manager.duplicates_received} duplicates"
+        assert c2.delta_manager.duplicates_received == 0, \
+            f"c2 saw {c2.delta_manager.duplicates_received} duplicates"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
