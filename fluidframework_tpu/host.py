@@ -13,8 +13,12 @@ so every app runs unchanged against every deployment shape:
     python -m fluidframework_tpu.host shared_text -t sharded  # 2-core
 
 Apps are repo-root ``examples/<name>`` modules exposing
-``run_clients(port) -> int`` (falling back to ``run_demo()`` for older
-examples that embed their own server).
+``run_clients(port) -> int`` — all seven (shared_text, clicker,
+table_doc, todo, canvas, sudoku, album) support every topology. An app
+without ``run_clients`` (a third-party module that embeds its own
+server) still runs via its ``run_demo()``, but only under ``-t
+single`` — the host refuses to spawn a topology such an app would
+silently ignore.
 """
 
 from __future__ import annotations

@@ -97,7 +97,7 @@ def test_admin_requires_secret_on_secured_deployment():
         core.wait(timeout=10)
 
 
-@pytest.mark.parametrize("app", ["todo", "canvas"])
+@pytest.mark.parametrize("app", ["todo", "canvas", "sudoku", "album"])
 def test_example_demo_converges(app):
     out = subprocess.run(
         [sys.executable, "-m", f"examples.{app}"],
