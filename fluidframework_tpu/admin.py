@@ -10,6 +10,13 @@ one CLI against the ordering core's admin frames (front_end.py
     python -m fluidframework_tpu.admin tenants --port P
     python -m fluidframework_tpu.admin tenant-add ID SECRET --port P
     python -m fluidframework_tpu.admin tenant-rm ID --port P
+    python -m fluidframework_tpu.admin monitor --port P [--interval S]
+                                               [--count N]
+
+``monitor`` is the service-monitor role (ref: server/service-monitor):
+each tick it measures the front door's ping RTT (event-loop health) and
+prints one line per live doc — seq, msn, connected clients, applier
+lag (seq - applierSeq; "-" when no applier stage reports).
 
 ``--admin-secret`` must match the core's ``--admin-secret`` whenever one
 is configured (and always on a tenancy-enforcing deployment).
@@ -25,13 +32,51 @@ import sys
 def _request(args, frame: dict) -> dict:
     from .driver.network import _Transport
 
-    if args.admin_secret:
-        frame["secret"] = args.admin_secret
     t = _Transport(args.host, args.port, timeout=10.0)
     try:
-        return t.request(frame)
+        return t.request(_frame(args, frame))
     finally:
         t.close()
+
+
+def _monitor(args) -> int:
+    """The service-monitor role: ping RTT + per-doc pipeline lag, one
+    block per tick on stdout (ref: server/service-monitor)."""
+    import time
+
+    from .driver.network import _Transport
+
+    t = _Transport(args.host, args.port, timeout=10.0)
+    try:
+        tick = 0
+        while True:
+            tick += 1
+            t0 = time.perf_counter()
+            docs = t.request(_frame(args, {"t": "admin_docs"}))["docs"]
+            rtt_ms = (time.perf_counter() - t0) * 1e3
+            print(f"tick {tick} rtt {rtt_ms:.1f}ms docs {len(docs)}")
+            for d in docs:
+                tenant, _, doc = d.partition("/")
+                st = t.request(_frame(args, {
+                    "t": "admin_status", "tenant": tenant,
+                    "doc": doc}))["status"]
+                if st is None:
+                    continue
+                lag = ("-" if st["applierSeq"] is None
+                       else st["seq"] - st["applierSeq"])
+                print(f"  {d}: seq {st['seq']} msn {st['msn']} "
+                      f"clients {len(st['clients'])} applier_lag {lag}")
+            if args.count and tick >= args.count:
+                return 0
+            time.sleep(args.interval)
+    finally:
+        t.close()
+
+
+def _frame(args, frame: dict) -> dict:
+    if args.admin_secret:
+        frame["secret"] = args.admin_secret
+    return frame
 
 
 def main(argv=None) -> int:
@@ -50,7 +95,14 @@ def main(argv=None) -> int:
     s.add_argument("secret")
     s = sub.add_parser("tenant-rm", help="deregister a tenant")
     s.add_argument("id")
+    s = sub.add_parser("monitor", help="live per-doc status ticker")
+    s.add_argument("--interval", type=float, default=2.0)
+    s.add_argument("--count", type=int, default=0,
+                   help="ticks before exiting (0 = forever)")
     args = p.parse_args(argv)
+
+    if args.cmd == "monitor":
+        return _monitor(args)
 
     if args.cmd == "status":
         reply = _request(args, {"t": "admin_status", "tenant": args.tenant,

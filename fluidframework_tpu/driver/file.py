@@ -31,31 +31,40 @@ from .definitions import (
 )
 
 
-def record_document(server, tenant_id: str, document_id: str,
-                    root_dir: str) -> str:
-    """Dump a live server's document to the file-driver layout (the
-    fetch-tool role): full sequenced log + latest acked summary."""
-    doc_dir = os.path.join(root_dir, tenant_id, document_id)
+def write_doc_dir(doc_dir: str, msgs: list, snap: Optional[dict]) -> str:
+    """THE on-disk writer for the file-driver layout — record_document
+    (in-proc) and replay/fetch.py (network) both serialize through here
+    so the format can never fork between them."""
     os.makedirs(doc_dir, exist_ok=True)
-    msgs = server.get_deltas(tenant_id, document_id, 0, 10**9)
     with open(os.path.join(doc_dir, "messages.json"), "w") as f:
         json.dump([message_to_dict(m) for m in msgs], f)
-    from .local import LocalStorage
-
-    snap = server.storage(tenant_id, document_id).get_snapshot_tree()
     if snap is not None:
         with open(os.path.join(doc_dir, "snapshot.json"), "w") as f:
             json.dump(snap, f)
     return doc_dir
 
 
+def record_document(server, tenant_id: str, document_id: str,
+                    root_dir: str) -> str:
+    """Dump a live server's document to the file-driver layout (the
+    fetch-tool role): full sequenced log + latest acked summary."""
+    msgs = server.get_deltas(tenant_id, document_id, 0, 10**9)
+    snap = server.storage(tenant_id, document_id).get_snapshot_tree()
+    return write_doc_dir(os.path.join(root_dir, tenant_id, document_id),
+                         msgs, snap)
+
+
 class FileDeltaStorage(DocumentDeltaStorage):
     def __init__(self, messages: list):
-        self._messages = messages  # index i holds seq i+1
+        self._messages = messages
+        # a fetched doc may hold only the TAIL of a retention-truncated
+        # log: index by the first message's actual seq, never assume
+        # messages[i] is seq i+1
+        self._first = (messages[0].sequence_number if messages else 1)
 
     def get_deltas(self, from_seq: int, to_seq: int):
-        lo = max(from_seq, 0)
-        hi = min(to_seq - 1, len(self._messages))
+        lo = max(from_seq - (self._first - 1), 0)
+        hi = min(to_seq - self._first, len(self._messages))
         return self._messages[lo:hi] if hi > lo else []
 
     @property

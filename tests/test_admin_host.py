@@ -122,3 +122,30 @@ def test_dev_host_runs_app_on_sharded_topology():
         capture_output=True, text=True, timeout=240, cwd="/root/repo")
     assert out.returncode == 0, out.stdout + out.stderr
     assert "CONVERGED" in out.stdout
+
+
+def test_admin_monitor_ticks_live_status(capsys):
+    """The service-monitor role: `admin monitor` prints ping RTT + one
+    line per live doc with its seq/msn/client-count/applier lag."""
+    core, port = _spawn(["fluidframework_tpu.service.front_end",
+                         "--port", "0"])
+    try:
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c = loader.resolve("t", "mondoc")
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "watch me")
+        t0 = time.time()
+        while c.runtime.pending.count > 0 and time.time() - t0 < 10:
+            time.sleep(0.02)
+
+        assert _admin(port, "monitor", "--interval", "0.2",
+                      "--count", "2") == 0
+        out = capsys.readouterr().out
+        assert out.count("tick ") == 2
+        assert "t/mondoc: seq " in out
+        assert "clients 1" in out
+        assert "applier_lag -" in out  # no applier stage attached
+    finally:
+        core.terminate()
+        core.wait(timeout=10)

@@ -335,3 +335,31 @@ def test_table_doc_example_demo_converges():
     assert "CONVERGED" in out.stdout
     assert "TOTAL" in out.stdout
     assert "region" in out.stdout
+
+
+def test_ping_answered_at_every_terminator(topology):
+    """The liveness probe (driver/network.py recv-timeout escalation)
+    must be answered at each hop a client can terminate at: the core
+    itself, the native C++ gateway relay (the fixture default), and the
+    pure-Python relay — a hop that relayed or dropped pings would make
+    idle clients behind it false-positive as dead after two windows."""
+    from fluidframework_tpu.driver.network import _Transport
+
+    core_port, gw_native, _ = topology
+    pygw, pyport = _spawn(["fluidframework_tpu.service.gateway",
+                           "--core-port", str(core_port), "--python"])
+    try:
+        for label, port in (("core", core_port),
+                            ("native-gateway", gw_native),
+                            ("python-gateway", pyport)):
+            t = _Transport("127.0.0.1", port, timeout=5.0)
+            got = []
+            t.on_push("pong", got.append)
+            try:
+                t.send({"t": "ping"})
+                assert wait_for(lambda: got), f"no pong from {label}"
+            finally:
+                t.close()
+    finally:
+        pygw.terminate()
+        pygw.wait(timeout=10)

@@ -88,3 +88,77 @@ def test_file_driver_documents_are_read_only():
         svc.connect_to_delta_stream()
     with pytest.raises(ReadOnlyDocumentError):
         svc.connect_to_storage().upload_summary({}, None)
+
+
+def test_fetch_live_doc_then_replay_offline(tmp_path):
+    """The fetch-tool role (packages/tools/fetch-tool): pull a LIVE
+    networked doc into the file-driver layout, then replay it OFFLINE
+    through the real client stack and converge to the live text. The
+    doc is deliberately aged past an acked summary with AGGRESSIVE log
+    retention (margin 0), so the service refuses from-zero delta reads
+    (LogTruncatedError) — fetch must reconstruct from the snapshot plus
+    the tail above its sequence number, the long-lived-production-doc
+    case the tool exists for."""
+    import os as _os
+    import subprocess
+    import sys
+    import time
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.replay.fetch import fetch_document
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    env = dict(_os.environ, FLUID_TPU_LOG_RETENTION_OPS="0")
+    core = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.front_end",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo", env=env)
+    try:
+        line = core.stdout.readline().strip()
+        assert line.startswith("LISTENING"), line
+        port = int(line.rsplit(":", 1)[1])
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c = loader.resolve("t", "fetchdoc")
+        sm = SummaryManager(c, max_ops=3)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "offline me")
+        s.remove_text(0, 4)
+        t0 = time.time()
+        while sm.summaries_acked == 0 and time.time() - t0 < 30:
+            time.sleep(0.02)
+        assert sm.summaries_acked >= 1  # retention has truncated below it
+        s.insert_text(0, "replay ")  # tail ops above the summary
+        t0 = time.time()
+        while c.runtime.pending.count and time.time() - t0 < 15:
+            time.sleep(0.02)
+        live_text = s.get_text()
+
+        # from-zero delta reads are refused now — fetch must cope
+        from fluidframework_tpu.driver.network import _Transport
+        t = _Transport("127.0.0.1", port, timeout=10.0)
+        try:
+            import pytest as _pytest
+            with _pytest.raises(RuntimeError, match="truncated"):
+                t.request({"t": "get_deltas", "tenant": "t",
+                           "doc": "fetchdoc", "from": 0, "to": 10**9})
+        finally:
+            t.close()
+
+        doc_dir = fetch_document("127.0.0.1", port, "t", "fetchdoc",
+                                 str(tmp_path))
+        assert os.path.exists(os.path.join(doc_dir, "messages.json"))
+    finally:
+        core.terminate()
+        core.wait(timeout=10)
+
+    # the service is GONE; the fetched artifact replays standalone
+    svc = FileDocumentService.from_dir(doc_dir)
+    ctl = ReplayController(svc)
+    assert ctl.container.existing  # booted from the fetched snapshot
+    result = ctl.run()
+    assert result["final_text"] == live_text == "replay ine me"
