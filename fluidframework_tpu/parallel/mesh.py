@@ -19,6 +19,19 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions: newer releases promote it to
+    the top-level namespace (param ``check_vma``); older ones ship it as
+    ``jax.experimental.shard_map`` (param ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(
     n_devices: int | None = None,
     seg_shards: int = 1,

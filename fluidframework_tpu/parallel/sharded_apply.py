@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.apply import F_TYPE, OP_NOOP, apply_ops_batch, compact_batch, wave_min_seq
 from ..ops.doc_state import DocState
+from .mesh import shard_map
 
 
 def doc_sharding(mesh: Mesh) -> NamedSharding:
@@ -55,7 +56,7 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
         return state, stats
 
     dp = P("docs")
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _local,
         mesh=mesh,
         in_specs=(dp, dp),
