@@ -221,10 +221,18 @@ def _apply_core(
 
     j1 = jnp.argmax(inside1)
     j2 = jnp.argmax(inside2)
-    o1 = pos - cum[j1]
-    o2 = p2 - cum[j2]
-    l1, ts1 = state.length[j1], state.text_start[j1]
-    l2, ts2 = state.length[j2], state.text_start[j2]
+    # split-segment field extracts as one-hot masked sums, NOT a[j]:
+    # inside1/inside2 are one-hot (positions strictly inside a visible
+    # segment match at most one slot), and a[j] with a batched j lowers
+    # to lax.gather under vmap — the TPU computed-index slow path
+    c1 = jnp.sum(jnp.where(inside1, cum, 0))
+    c2 = jnp.sum(jnp.where(inside2, cum, 0))
+    o1 = pos - c1
+    o2 = p2 - c2
+    l1 = jnp.sum(jnp.where(inside1, state.length, 0))
+    ts1 = jnp.sum(jnp.where(inside1, state.text_start, 0))
+    l2 = jnp.sum(jnp.where(inside2, state.length, 0))
+    ts2 = jnp.sum(jnp.where(inside2, state.text_start, 0))
     same = s1 & s2 & (j1 == j2)  # both splits inside one segment
 
     # output indices of the new/patched slots
@@ -297,8 +305,8 @@ def _apply_core(
     # excludes is_rem/is_ann, so the mask is dead in that case.
     vis_out = jnp.where(d1, _shift1(vis), jnp.where(d2, _shift2(vis), vis))
     cum_out = jnp.where(d1, _shift1(cum), jnp.where(d2, _shift2(cum), cum))
-    cum_out = jnp.where(n1_at, cum[j1] + o1, cum_out)
-    cum_out = jnp.where(n2_at, cum[j2] + o2, cum_out)
+    cum_out = jnp.where(n1_at, c1 + o1, cum_out)
+    cum_out = jnp.where(n2_at, c2 + o2, cum_out)
     vlen_out = jnp.where(vis_out, st.length, 0)
     covered = vis_out & (cum_out >= pos) & (cum_out + vlen_out <= end)
     rm = is_rem & ~bad & covered
