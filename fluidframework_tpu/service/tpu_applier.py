@@ -109,14 +109,16 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
         def unpack(wave16, bases):
             w = wave16.astype(jnp.int32)
             typ = w[..., F_TYPE]
-            seq = bases[:, None, 0] + w[..., F_SEQ]
+            # bases[:, :1] (a pure slice), NOT bases[:, None, 0]: the
+            # None-mixed static index lowers to lax.gather
+            seq = bases[:, :1] + w[..., F_SEQ]
             ref = seq - w[..., F_REFSEQ]
             # NOOP padding must not lift the per-doc zamboni floor
             # (wave_min_seq is a max): park its msn far below any real one
             msn = jnp.where(typ == OP_NOOP, -(1 << 20), seq - w[..., F_MSN])
             client = w[..., F_CLIENT]
             client = jnp.where(client == 32767, SYSTEM_CLIENT, client)
-            tstart = bases[:, None, 1] + w[..., F_TSTART]
+            tstart = bases[:, 1:] + w[..., F_TSTART]
             return jnp.stack(
                 [typ, w[..., F_POS], w[..., F_END], seq, ref, client,
                  w[..., F_TLEN], tstart, msn, w[..., F_FLAGS],
