@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils.contracts import kernel_contract
 from .doc_state import NO_KEY, NO_SEQ, DocState
 
 NO_CLIENT = -1
@@ -224,7 +225,8 @@ def _apply_core(
     # split-segment field extracts as one-hot masked sums, NOT a[j]:
     # inside1/inside2 are one-hot (positions strictly inside a visible
     # segment match at most one slot), and a[j] with a batched j lowers
-    # to lax.gather under vmap — the TPU computed-index slow path
+    # to lax.gather under vmap — the computed-index path the kernel
+    # contract forbids (tools/fluidlint jaxpr pass, no_gather)
     c1 = jnp.sum(jnp.where(inside1, cum, 0))
     c2 = jnp.sum(jnp.where(inside2, cum, 0))
     o1 = pos - c1
@@ -371,8 +373,27 @@ def apply_ops_scan(state: DocState, ops) -> DocState:
     return out
 
 
-# [D docs] × [K ops each]: the batched hot loop
-apply_ops_batch = jax.vmap(apply_ops_scan)
+def _contract_example():
+    """Small representative wave: [D=8 docs, K=4 ops, S=16 slots]."""
+    D, S, K = 8, 16, 4
+    state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+    ops = jnp.zeros((D, K, OP_FIELDS), jnp.int32)
+    return (state, ops), {}
+
+
+# [D docs] × [K ops each]: the batched hot loop. The contract IS the
+# ARCHITECTURE.md claim: the K-amplified apply is strictly rolls +
+# selects — zero computed-index gathers/scatters, zero dynamic slices,
+# one compile per wave shape (enforced by tools/fluidlint).
+apply_ops_batch = kernel_contract(
+    "ops.apply_ops_batch",
+    example=_contract_example,
+    no_gather=True,
+    no_scatter=True,
+    max_dynamic_slices=0,
+    single_jit=True,
+    notes="batched merge-tree apply: the K-amplified hot path",
+)(jax.vmap(apply_ops_scan))
 
 
 def wave_min_seq(ops) -> jax.Array:

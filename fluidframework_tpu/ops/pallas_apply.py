@@ -58,6 +58,7 @@ from .apply import (
     OP_INSERT,
     OP_REMOVE,
 )
+from ..utils.contracts import kernel_contract
 from .doc_state import NO_KEY, NO_SEQ, DocState
 
 R = 8  # docs per grid instance: one full VPU sublane tile
@@ -281,6 +282,25 @@ def _kernel(ops_ref, length, tstart, flags, iseq, icl, rseq, rca, rcb,
     o_ovf[...] = out[11]
 
 
+def _contract_example():
+    """One R-tile wave in interpret mode (the checker runs on CPU)."""
+    D, S, K = R, 16, 4
+    state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+    ops = jnp.zeros((D, K, OP_FIELDS), jnp.int32)
+    return (state, ops), {"interpret": True}
+
+
+# contract: the VMEM-resident apply must stay roll/select like its XLA
+# twin — the checker walks INTO the pallas_call kernel jaxpr, so a
+# gather smuggled into the Mosaic body fails the same way
+@kernel_contract(
+    "ops.pallas_apply_ops_batch",
+    example=_contract_example,
+    no_gather=True,
+    no_scatter=True,
+    single_jit=True,
+    notes="Pallas VMEM-resident apply (tile of R docs)",
+)
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_apply_ops_batch(state: DocState, ops: jax.Array,
                            interpret: bool = False) -> DocState:

@@ -19,8 +19,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.apply import F_TYPE, OP_NOOP, apply_ops_batch, compact_batch, wave_min_seq
+from ..ops.apply import (
+    F_TYPE,
+    OP_FIELDS,
+    OP_NOOP,
+    apply_ops_batch,
+    compact_batch,
+    wave_min_seq,
+)
 from ..ops.doc_state import DocState
+from ..utils.contracts import register_kernel_contract
 from .mesh import shard_map
 
 
@@ -64,3 +72,34 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def _contract_build():
+    """Build the sharded step on a 1-device 'docs' mesh — the contract
+    is about the traced program, which is shard-count-invariant."""
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("docs",))
+    step = make_sharded_step(mesh, donate=False)
+
+    def example():
+        D, S, K = 8, 16, 4
+        state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+        state = shard_state(state, mesh)
+        ops = jnp.zeros((D, K, OP_FIELDS), jnp.int32)
+        return (state, ops), {}
+
+    return step, example
+
+
+# contract: per-op path gather-free; the only gathers are zamboni
+# compaction's once-per-wave argsort repack (one per DocState field).
+# Collectives (psum of stats) are not memory gathers and don't count.
+register_kernel_contract(
+    "parallel.sharded_step",
+    build=_contract_build,
+    no_scatter=True,
+    max_gathers=10,
+    single_jit=True,
+    notes="doc-sharded apply + fused zamboni over the 'docs' mesh axis",
+)

@@ -62,6 +62,7 @@ from ..ops.apply import (
 from ..ops.doc_state import FLAG_MARKER, DocState, PropTable, TextArena, decode_state
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from ..parallel.placement import DocPlacement
+from ..utils.contracts import register_kernel_contract
 
 MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 
@@ -110,7 +111,8 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
             w = wave16.astype(jnp.int32)
             typ = w[..., F_TYPE]
             # bases[:, :1] (a pure slice), NOT bases[:, None, 0]: the
-            # None-mixed static index lowers to lax.gather
+            # None-mixed static index lowers to lax.gather, and the
+            # kernel contract budgets gathers to compaction only
             seq = bases[:, :1] + w[..., F_SEQ]
             ref = seq - w[..., F_REFSEQ]
             # NOOP padding must not lift the per-doc zamboni floor
@@ -137,6 +139,37 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
               jax.jit(dense_step_wide, donate_argnums=(0,)))
         _DENSE_STEP_CACHE[(D, K, use_pallas, pallas_interpret)] = fn
     return fn
+
+
+def _contract_build():
+    """The int16 packed wave applier at a small fixed geometry."""
+    D, K = 8, 4
+    packed_fn, _wide_fn = _dense_step_for(D, K)
+
+    def example():
+        S = 16
+        state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+        wave16 = jnp.zeros((D, K, OP_FIELDS), jnp.int16)
+        bases = jnp.zeros((D, 2), jnp.int32)
+        return (state, wave16, bases), {}
+
+    return packed_fn, example
+
+
+# contract: the wave arrives int16 and must be EXPLICITLY widened before
+# any arithmetic (no_int16_arithmetic catches silent promotion); the
+# unpack+apply is gather-free, the fused zamboni repack owns the only
+# gathers (one per DocState field, once per wave, off the K-amplified
+# path); one compile per (D, K) geometry.
+register_kernel_contract(
+    "service.dense_step_packed",
+    build=_contract_build,
+    no_scatter=True,
+    max_gathers=10,
+    no_int16_arithmetic=True,
+    single_jit=True,
+    notes="int16 packed-wave unpack + batched apply + fused zamboni",
+)
 
 
 def channel_stream(server, tenant_id: str, document_id: str,

@@ -1,7 +1,13 @@
 """Base utils (SURVEY §1.1): telemetry logger, perf events, metrics,
-wire-trace consumption.
+wire-trace consumption, kernel-contract registry.
 """
 
+from .contracts import (  # noqa: F401
+    KernelContract,
+    kernel_contract,
+    register_kernel_contract,
+    registered_contracts,
+)
 from .telemetry import (  # noqa: F401
     BufferSink,
     Counters,

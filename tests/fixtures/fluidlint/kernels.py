@@ -1,0 +1,34 @@
+"""Fixture kernels for the jaxpr pass — loaded by file path, never
+imported as part of the tree (the hygiene/layer passes skip fixtures/).
+
+``gatherful_kernel`` is the canonical TPU slow path: a computed-index
+read per row, which vmap lowers to ``lax.gather``. ``clean_kernel``
+computes the same values via a one-hot masked sum (the idiom
+ops/apply.py uses). The int16 pair mirrors the packed-wave unpack in
+service/tpu_applier.py with and without the explicit width cast.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def gatherful_kernel(a, idx):
+    # a[i, idx[i]] per row: batches to a gather primitive under vmap
+    return jax.vmap(lambda row, j: row[j])(a, idx)
+
+
+def clean_kernel(a, idx):
+    # same result, gather-free: one-hot mask + masked sum
+    cols = jnp.arange(a.shape[-1])[None, :]
+    mask = cols == idx[:, None]
+    return jnp.sum(jnp.where(mask, a, 0), axis=-1)
+
+
+def int16_promoting_kernel(wave16, bases):
+    # the delta is scaled while still int16 — the multiply runs at
+    # int16 width and can overflow before the (implicit) widening
+    return bases[:, :1] + wave16 * 2
+
+
+def int16_clean_kernel(wave16, bases):
+    return bases[:, :1] + wave16.astype(jnp.int32) * 2

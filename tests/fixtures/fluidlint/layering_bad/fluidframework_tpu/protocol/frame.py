@@ -1,0 +1,3 @@
+"""Fixture: a legal import — protocol may use utils."""
+
+from fluidframework_tpu.utils import leaky  # noqa: F401  (legal)

@@ -1,0 +1,4 @@
+"""Fixture: a deliberate layering violation — the bottom layer (utils)
+reaching UP into protocol. fluidlint's layer pass must flag this."""
+
+from fluidframework_tpu.protocol import frame  # noqa: F401  (violation)

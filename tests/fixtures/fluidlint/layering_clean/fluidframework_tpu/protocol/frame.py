@@ -1,0 +1,5 @@
+"""Fixture: protocol importing utils is within the layer DAG — clean."""
+
+from fluidframework_tpu.utils import helper  # noqa: F401  (legal)
+
+WIDTH = helper.SENTINEL

@@ -1,0 +1,3 @@
+"""Fixture: the bottom layer imports no siblings — clean."""
+
+SENTINEL = 1

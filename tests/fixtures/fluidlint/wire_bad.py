@@ -1,0 +1,27 @@
+"""Fixture: wire/width violations the wire pass must flag.
+
+Never imported — parsed by AST only.
+"""
+
+import struct
+
+import numpy as np
+
+# not explicitly big-endian: native order varies by platform
+HEADER = struct.Struct("HHi")
+
+# native-size code 'l' changes width across platforms
+TRAILER = struct.Struct(">Hl")
+
+
+def apply_delta(wave16, base):
+    # arithmetic on an int16 wave without an explicit cast: silent
+    # promotion — the packed-wave width bug
+    seq = wave16 + base
+    return seq
+
+
+def scale_packed(n):
+    w = np.zeros(n, np.int16)
+    w *= 4  # in-place arithmetic on an int16 array
+    return w

@@ -1,0 +1,15 @@
+"""Fixture: wire/width discipline done right — the wire pass must come
+back clean on this file.
+"""
+
+import struct
+
+import numpy as np
+
+HEADER = struct.Struct(">HHi")
+
+
+def apply_delta(wave16, base):
+    # explicit widening before math: the sanctioned pattern
+    wide = wave16.astype(np.int32)
+    return wide + base

@@ -1,0 +1,222 @@
+"""fluidlint self-tests: each pass catches its fixture violation and
+comes back clean on the clean twin (and on the real tree).
+
+Fixtures live in tests/fixtures/fluidlint/ — a deliberate layering
+violation, a deliberately gather-ful kernel, and an int16-promotion
+bug. The hygiene/layer walkers skip fixtures/ directories, so the bad
+fixtures never pollute the real-tree run.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from fluidframework_tpu.utils.contracts import kernel_contract
+from tools.fluidlint import hygiene, jaxpr_check, layers, wire_check
+
+HERE = os.path.dirname(__file__)
+FIX = os.path.join(HERE, "fixtures", "fluidlint")
+REPO = os.path.abspath(os.path.join(HERE, ".."))
+
+BAD_TREE = os.path.join(FIX, "layering_bad", "fluidframework_tpu")
+CLEAN_TREE = os.path.join(FIX, "layering_clean", "fluidframework_tpu")
+
+
+# ---------------------------------------------------------------- layers
+
+def test_layering_violation_caught():
+    vs = layers.check_layers(root=BAD_TREE, repo_root=FIX)
+    assert len(vs) == 1, [str(v) for v in vs]
+    v = vs[0]
+    assert "'utils' may not import 'protocol'" in v.message
+    assert v.path.endswith("utils/leaky.py")
+    assert v.line > 0
+    assert "protocol" in v.suggestion  # names the layers it IS legal from
+
+
+def test_layering_clean_fixture_passes():
+    assert layers.check_layers(root=CLEAN_TREE, repo_root=FIX) == []
+
+
+def test_unclassified_subpackage_caught(tmp_path):
+    root = tmp_path / "fluidframework_tpu"
+    (root / "rogue").mkdir(parents=True)
+    (root / "rogue" / "__init__.py").write_text("")
+    vs = layers.check_classified(root=str(root), repo_root=str(tmp_path))
+    assert len(vs) == 1 and "'rogue'" in vs[0].message
+
+
+def test_emit_packages_md_is_deterministic():
+    a = layers.emit_packages_md(repo_root=REPO)
+    b = layers.emit_packages_md(repo_root=REPO)
+    assert a == b
+    assert "GENERATED" in a
+    # every classified layer appears as a section
+    for pkg in layers.ALLOWED:
+        assert f"## {pkg}" in a
+
+
+def test_stale_packages_md_caught(tmp_path):
+    md = tmp_path / "PACKAGES.md"
+    md.write_text("# PACKAGES\n\nstale by hand-editing\n")
+    vs = layers.check_packages_md(md_path=str(md), repo_root=REPO)
+    assert len(vs) == 1 and "stale" in vs[0].message
+
+
+# ----------------------------------------------------------------- jaxpr
+
+def _fixture_kernels():
+    spec = importlib.util.spec_from_file_location(
+        "fluidlint_fixture_kernels", os.path.join(FIX, "kernels.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _example_gather():
+    return ((jnp.arange(12.0).reshape(3, 4), jnp.array([0, 2, 1])), {})
+
+
+def _example_int16():
+    return ((jnp.zeros((3, 4), jnp.int16), jnp.zeros((3, 2), jnp.int32)),
+            {})
+
+
+def test_gatherful_kernel_caught():
+    mod = _fixture_kernels()
+    reg: dict = {}
+    kernel_contract("fixture.gatherful", example=_example_gather,
+                    no_gather=True, registry=reg)(mod.gatherful_kernel)
+    vs = jaxpr_check.check_kernels(registry=reg, required=())
+    assert len(vs) == 1, [str(v) for v in vs]
+    assert "gather" in vs[0].message and "no_gather" in vs[0].message
+
+
+def test_clean_kernel_passes():
+    mod = _fixture_kernels()
+    reg: dict = {}
+    kernel_contract("fixture.clean", example=_example_gather,
+                    no_gather=True, no_scatter=True, single_jit=True,
+                    registry=reg)(mod.clean_kernel)
+    assert jaxpr_check.check_kernels(registry=reg, required=()) == []
+
+
+def test_int16_promotion_caught():
+    mod = _fixture_kernels()
+    reg: dict = {}
+    kernel_contract("fixture.int16_promoting", example=_example_int16,
+                    no_int16_arithmetic=True,
+                    registry=reg)(mod.int16_promoting_kernel)
+    vs = jaxpr_check.check_kernels(registry=reg, required=())
+    assert len(vs) == 1, [str(v) for v in vs]
+    assert "int16" in vs[0].message
+
+
+def test_int16_clean_passes():
+    mod = _fixture_kernels()
+    reg: dict = {}
+    kernel_contract("fixture.int16_clean", example=_example_int16,
+                    no_int16_arithmetic=True,
+                    registry=reg)(mod.int16_clean_kernel)
+    assert jaxpr_check.check_kernels(registry=reg, required=()) == []
+
+
+def test_missing_required_registration_flagged():
+    vs = jaxpr_check.check_kernels(registry={},
+                                   required=("ops.apply_ops_batch",))
+    assert len(vs) == 1 and "not registered" in vs[0].message
+
+
+def test_real_registry_covers_required_kernels():
+    reg = jaxpr_check.load_registry()
+    for name in jaxpr_check.REQUIRED_KERNELS:
+        assert name in reg, f"{name} lost its contract registration"
+
+
+def test_batched_apply_jaxpr_is_gather_free():
+    """The acceptance-criterion check, as a direct assertion: the
+    registered batched-apply kernel's jaxpr has NO gather/scatter."""
+    reg = jaxpr_check.load_registry()
+    contract = reg["ops.apply_ops_batch"]
+    fn, example = contract.build()
+    args, kwargs = example()
+    closed = jaxpr_check._trace(fn, args, kwargs)
+    counts = jaxpr_check.primitive_counts(closed.jaxpr)
+    assert counts.get("gather", 0) == 0, counts
+    assert not any(p.startswith("scatter") for p in counts), counts
+
+
+# ------------------------------------------------------------------ wire
+
+def test_wire_bad_fixture_caught():
+    vs = wire_check.check_wire(
+        paths=(os.path.join(FIX, "wire_bad.py"),), repo_root=FIX)
+    msgs = [v.message for v in vs]
+    assert any("not explicitly big-endian" in m for m in msgs), msgs
+    assert any("non-fixed-width" in m for m in msgs), msgs
+    assert any("arithmetic on int16 array 'wave16'" in m
+               for m in msgs), msgs
+    assert any("in-place arithmetic on int16 array 'w'" in m
+               for m in msgs), msgs
+
+
+def test_wire_clean_fixture_passes():
+    assert wire_check.check_wire(
+        paths=(os.path.join(FIX, "wire_clean.py"),), repo_root=FIX) == []
+
+
+def test_wire_real_tree_clean():
+    assert wire_check.check_wire(repo_root=REPO) == []
+
+
+# --------------------------------------------------------------- hygiene
+
+def test_hygiene_catches_all_three(tmp_path):
+    p = tmp_path / "sloppy.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "ZEROS = jnp.zeros(4)\n"
+        "def f(x=[]):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except:\n"
+        "        return None\n")
+    vs = hygiene.check_file(str(p), repo_root=str(tmp_path),
+                            import_silent=True)
+    msgs = [v.message for v in vs]
+    assert any("bare `except:`" in m for m in msgs), msgs
+    assert any("mutable default" in m for m in msgs), msgs
+    assert any("import time" in m for m in msgs), msgs
+
+
+def test_hygiene_real_tree_clean():
+    assert hygiene.check_hygiene(repo_root=REPO) == []
+
+
+# ------------------------------------------------------------------- CLI
+
+def _run_cli(*argv, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.fluidlint", *argv],
+        cwd=cwd, env=env, capture_output=True, text=True)
+
+
+def test_cli_clean_on_real_tree_fast_passes():
+    # layers + wire + hygiene; the jaxpr pass is covered in-process above
+    r = _run_cli("--pass", "layers", "--pass", "wire", "--pass", "hygiene")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_exits_nonzero_on_violation():
+    bad_root = os.path.join(FIX, "layering_bad")
+    r = _run_cli("--pass", "layers", "--repo-root", bad_root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "'utils' may not import 'protocol'" in r.stdout

@@ -1,133 +1,52 @@
 """Layer-check: enforce the package import DAG.
 
-Ref: tools/build-tools/src/layerCheck — the reference CI fails any build
-whose packages import across the declared layer boundaries
-(README.md:54-56, docs/PACKAGES.md is the generated layer list). Here the
-same guarantee is one AST pass over the tree: each subpackage may import
-only from the layers at or below it.
+Thin wrapper: the layer table (``ALLOWED``) and the AST import walk
+live in ``tools/fluidlint/layers.py`` — the single source of truth
+shared by this test, ``python -m tools.fluidlint`` (pass 1), and the
+generated ``PACKAGES.md``. This test only asserts the checker comes
+back clean, so the DAG cannot drift between CI and the lint tool.
 
-Layering (bottom → top), mirroring SURVEY §1's layer map:
-
-    utils                (L1 base utils / telemetry)
-    protocol             (L0 defs + L2 shared consensus kernel)
-    mergetree            (L6 CRDT core)
-    ops, parallel        (TPU kernels / sharding over the mergetree model)
-    dds                  (L6 DDS catalog)
-    runtime              (L5)
-    loader               (L4; the loader imports DRIVER interfaces)
-    driver               (L3 — may bind to service for the local driver)
-    framework            (L7)
-    service              (S-layers: its own branch; may use protocol,
-                          utils, mergetree-adjacent kernels, driver wire
-                          helpers — but never runtime/loader/framework)
-    replay, native       (tools / bindings)
+Ref: tools/build-tools/src/layerCheck — the reference CI fails any
+build whose packages import across the declared layer boundaries
+(README.md:54-56, docs/PACKAGES.md is the generated layer list).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 
-import pytest
+from tools.fluidlint import layers
 
-ROOT = os.path.join(os.path.dirname(__file__), "..", "fluidframework_tpu")
+ROOT = os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "fluidframework_tpu"))
 
-#: subpackage → the set of sibling subpackages it may import from.
-#: An import of a package not in its set is a layering violation.
-ALLOWED = {
-    "utils": set(),
-    "protocol": {"utils"},
-    "mergetree": {"protocol", "utils"},
-    "ops": {"mergetree", "protocol", "utils"},
-    "parallel": {"ops", "mergetree", "protocol", "utils"},
-    "dds": {"mergetree", "ops", "protocol", "utils"},
-    "runtime": {"dds", "mergetree", "ops", "protocol", "utils"},
-    "loader": {"runtime", "dds", "mergetree", "protocol", "utils",
-               "driver"},
-    # drivers bind the loader contracts to a service; the local driver
-    # reaches into service (the reference's local-driver does the same —
-    # localDocumentService.ts binds straight to LocalDeltaConnectionServer)
-    "driver": {"protocol", "utils", "service", "mergetree"},
-    "framework": {"loader", "runtime", "dds", "mergetree", "protocol",
-                  "utils"},
-    # the service branch: protocol + utils + the TPU kernel stack; the
-    # wire helpers live in driver (shared transport), NEVER runtime/loader
-    "service": {"protocol", "utils", "ops", "parallel", "mergetree",
-                "driver", "native"},
-    "native": {"utils"},
-    "replay": {"loader", "driver", "runtime", "dds", "protocol", "utils",
-               "service", "mergetree"},
-}
-
-
-def _imports_of(path: str) -> set[str]:
-    """Sibling fluidframework_tpu subpackages imported by this module."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    depth_from_root = os.path.relpath(
-        path, ROOT).count(os.sep)  # 0 = top-level module
-    out = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            if node.level == 0:
-                mod = node.module or ""
-                if mod.startswith("fluidframework_tpu."):
-                    out.add(mod.split(".")[1])
-            else:
-                # relative: level 1 inside pkg/x.py = same package;
-                # level 2 = the framework root (..sibling)
-                if node.level == depth_from_root + 1 and node.module:
-                    out.add(node.module.split(".")[0])
-                elif node.level > depth_from_root + 1:
-                    out.add("<outside-package>")
-        elif isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name.startswith("fluidframework_tpu."):
-                    out.add(alias.name.split(".")[1])
-    return out
-
-
-def _package_files():
-    for pkg in sorted(ALLOWED):
-        pkg_dir = os.path.join(ROOT, pkg)
-        if not os.path.isdir(pkg_dir):
-            continue
-        for dirpath, _, files in os.walk(pkg_dir):
-            for fn in sorted(files):
-                if fn.endswith(".py"):
-                    yield pkg, os.path.join(dirpath, fn)
+#: Re-exported for anything that imported the table from here.
+ALLOWED = layers.ALLOWED
 
 
 def test_layer_dag():
-    violations = []
-    for pkg, path in _package_files():
-        allowed = ALLOWED[pkg] | {pkg}
-        for dep in _imports_of(path):
-            # only sibling SUBPACKAGES are layered; top-level modules
-            # (config.py — the cross-cutting unified registry) are free
-            if dep not in ALLOWED:
-                continue
-            if dep not in allowed:
-                rel = os.path.relpath(path, ROOT)
-                violations.append(f"{rel}: {pkg} -> {dep}")
+    violations = layers.check_layers(root=ROOT)
     assert not violations, (
-        "layering violations (see ALLOWED in this file):\n  "
-        + "\n  ".join(violations))
+        "layering violations (see ALLOWED in tools/fluidlint/layers.py):"
+        "\n  " + "\n  ".join(str(v) for v in violations))
 
 
 def test_every_subpackage_is_classified():
     """A new subpackage must be placed in the layer map explicitly."""
-    found = {d for d in os.listdir(ROOT)
-             if os.path.isdir(os.path.join(ROOT, d))
-             and not d.startswith("__")}
-    unclassified = found - set(ALLOWED)
-    assert not unclassified, (
-        f"subpackages missing from the layer map: {sorted(unclassified)}")
+    violations = layers.check_classified(root=ROOT)
+    assert not violations, "\n".join(str(v) for v in violations)
 
 
 def test_mergetree_never_imports_service():
     """The canonical violation the reference's layer-check exists to stop
     (CRDT core depending on the service) stays impossible."""
-    for pkg, path in _package_files():
+    for pkg, path in layers.package_files(ROOT, layers.ALLOWED):
         if pkg == "mergetree":
-            assert "service" not in _imports_of(path), path
+            deps = {d for d, _, _ in layers.sibling_imports(path, ROOT)}
+            assert "service" not in deps, path
+
+
+def test_packages_md_is_fresh():
+    """The checked-in PACKAGES.md matches what the table generates."""
+    violations = layers.check_packages_md()
+    assert not violations, "\n".join(str(v) for v in violations)

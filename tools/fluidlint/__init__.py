@@ -1,0 +1,21 @@
+"""fluidlint: the repo's static contract checker.
+
+Three passes, mirroring how the reference enforces its architecture
+mechanically (tools/build-tools/src/layerCheck + generated PACKAGES.md):
+
+1. **layers** — the package import DAG (`layers.ALLOWED` is the single
+   source of truth; `tests/test_layering.py` delegates here) plus the
+   generated `PACKAGES.md` staleness check.
+2. **jaxpr** — TPU hot-path contracts: every registered kernel
+   (`fluidframework_tpu.utils.contracts`) is abstract-evaled and its
+   jaxpr checked for forbidden primitives (gather/scatter/dynamic-index
+   while bodies), int16 silent promotion, and recompile regressions.
+3. **wire** — wire-format widths: int16 packed-wave discipline and
+   struct width/endianness in the binary codec; plus repo-wide hygiene
+   (bare except, mutable defaults, import-time jnp calls).
+
+Run ``python -m tools.fluidlint`` (exit 1 on any violation); wired into
+tier-1 via ``tests/test_fluidlint.py`` and ``tools/lint.sh``.
+"""
+
+from .report import Violation  # noqa: F401

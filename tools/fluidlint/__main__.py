@@ -1,0 +1,85 @@
+"""CLI: ``python -m tools.fluidlint [--pass NAME]... [--emit-packages-md]``.
+
+Exit codes: 0 clean, 1 violations found, 2 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+PASSES = ("layers", "jaxpr", "wire", "hygiene")
+
+
+def run(passes, repo_root: str) -> list:
+    from . import hygiene, jaxpr_check, layers, wire_check
+
+    violations = []
+    if "layers" in passes:
+        violations += layers.check_layers(repo_root=repo_root)
+        violations += layers.check_classified(repo_root=repo_root)
+        violations += layers.check_packages_md(repo_root=repo_root)
+    if "jaxpr" in passes:
+        violations += jaxpr_check.check_kernels()
+    if "wire" in passes:
+        violations += wire_check.check_wire(repo_root=repo_root)
+    if "hygiene" in passes:
+        violations += hygiene.check_hygiene(repo_root=repo_root)
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.fluidlint",
+        description="static contract checker: layer DAG, TPU hot-path "
+                    "jaxpr contracts, wire-format widths")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES, metavar="|".join(PASSES),
+                    help="run only the named pass (repeatable); "
+                         "default: all")
+    ap.add_argument("--emit-packages-md", nargs="?", const="PACKAGES.md",
+                    metavar="PATH",
+                    help="regenerate the layer listing (like the "
+                         "reference's generated PACKAGES.md) and exit")
+    ap.add_argument("--repo-root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    repo_root = args.repo_root or os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+
+    if args.emit_packages_md is not None:
+        from . import layers
+
+        out_path = args.emit_packages_md
+        if not os.path.isabs(out_path):
+            out_path = os.path.join(repo_root, out_path)
+        content = layers.emit_packages_md(repo_root=repo_root)
+        with open(out_path, "w") as f:
+            f.write(content)
+        print(f"wrote {out_path}")
+        return 0
+
+    # the jaxpr pass traces kernels; keep it off any real accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    passes = tuple(args.passes) if args.passes else PASSES
+    violations = run(passes, repo_root)
+    for v in violations:
+        print(v)
+    n = len(violations)
+    names = ", ".join(passes)
+    if n:
+        print(f"\nfluidlint: {n} violation(s) [{names}]")
+        return 1
+    print(f"fluidlint: clean [{names}]")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception:  # noqa: BLE001 — distinguish crash from findings
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(2)

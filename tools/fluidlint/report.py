@@ -1,0 +1,23 @@
+"""Violation model shared by every fluidlint pass."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding: where, which pass, what, and how to fix it."""
+
+    pass_name: str  # "layers" | "jaxpr" | "wire" | "hygiene"
+    path: str       # repo-relative when possible
+    line: int       # 1-based; 0 = whole-file / non-source finding
+    message: str
+    suggestion: str = ""
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{loc}: [{self.pass_name}] {self.message}"
+        if self.suggestion:
+            out += f"\n    -> {self.suggestion}"
+        return out

@@ -1,0 +1,233 @@
+"""Pass 3 — wire/width lint: the packed-wave and binary-codec widths.
+
+The int16 packed wave (service/tpu_applier.py) and the struct-packed
+socket frames (protocol/binwire.py) are both WIDTH contracts: a field
+that silently widens (numpy promotes int16 + python-int to a wider
+dtype without complaint) or a struct code whose size is
+platform-dependent corrupts the wire without any test noticing until
+bytes disagree across hosts. This pass enforces, by AST:
+
+- **int16 discipline**: any name bound to an int16 array (``np.int16``
+  / ``astype(int16)`` / a dtype argument / the ``*16`` naming
+  convention of the wave format) may not appear as an operand of
+  arithmetic — it must be explicitly widened (``.astype(...)``) first.
+  The range-checked fallback to the int32 wide path is the sanctioned
+  escape hatch; silent promotion is not.
+- **struct widths**: every ``struct.Struct`` format in the wire codec
+  must be explicitly big-endian (``>``) and use only fixed-width codes
+  — native-size codes (``l``, ``L``, ``n``, ``P``, or a bare native
+  prefix) change width across platforms.
+
+The dtype-level twin of the int16 rule runs in pass 2: the registered
+packed-wave kernel's jaxpr must contain no arithmetic primitive
+consuming an int16 operand (``no_int16_arithmetic``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .report import Violation
+
+#: Files the wire pass covers on the real tree (repo-relative).
+WIRE_FILES = (
+    "fluidframework_tpu/protocol/binwire.py",
+    "fluidframework_tpu/service/tpu_applier.py",
+)
+
+#: struct format codes whose width is fixed and identical everywhere.
+_FIXED_WIDTH_CODES = set("xbBhHiIqQefds")
+
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+              ast.Pow, ast.LShift, ast.RShift)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_int16_dtype_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int16":
+        return True
+    return _dotted(node) in ("np.int16", "numpy.int16", "jnp.int16",
+                             "jax.numpy.int16")
+
+
+def _makes_int16(node: ast.AST) -> bool:
+    """Does this expression evaluate to an int16 array/scalar?"""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if _is_int16_dtype_expr(f):           # np.int16(x)
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "astype":
+        return any(_is_int16_dtype_expr(a) for a in node.args) or any(
+            _is_int16_dtype_expr(k.value) for k in node.keywords)
+    # np.zeros(shape, np.int16) / np.empty(..., dtype=np.int16) / ...
+    args = list(node.args) + [k.value for k in node.keywords]
+    return any(_is_int16_dtype_expr(a) for a in args)
+
+
+class _Int16Scope(ast.NodeVisitor):
+    """One function (or module) scope: track int16-tainted names and
+    flag arithmetic whose operand is tainted."""
+
+    def __init__(self, path: str, violations: list):
+        self.path = path
+        self.violations = violations
+        self.tainted: set[str] = set()
+
+    # -- taint sources ----------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _makes_int16(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.add(t.id)
+        elif isinstance(node.value, ast.Name) \
+                and node.value.id in self.tainted:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.add(t.id)
+        else:
+            # rebinding a tainted name to something else clears it
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.discard(t.id)
+        self.generic_visit(node)
+
+    def add_params(self, fnode) -> None:
+        # the wire format's naming convention: wave16, w16, ... params
+        # carry packed int16 payloads
+        for a in list(fnode.args.args) + list(fnode.args.kwonlyargs):
+            if a.arg.endswith("16"):
+                self.tainted.add(a.arg)
+
+    # -- nested functions get their own scope -----------------------------
+    def visit_FunctionDef(self, node) -> None:
+        _check_scope(node, self.path, self.violations)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- sinks ------------------------------------------------------------
+    def _operand_taint(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) and node.id in self.tainted:
+            return node.id
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in self.tainted:
+                return base.id
+        return None
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, _ARITH_OPS):
+            for side in (node.left, node.right):
+                name = self._operand_taint(side)
+                if name is not None:
+                    self.violations.append(Violation(
+                        pass_name="wire", path=self.path, line=node.lineno,
+                        message=f"arithmetic on int16 array '{name}' "
+                                "without an explicit width cast",
+                        suggestion="widen first (`x.astype(np.int32)`) or "
+                                   "route out-of-range values to the "
+                                   "range-checked int32 wide path"))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, _ARITH_OPS):
+            name = self._operand_taint(node.target) \
+                or self._operand_taint(node.value)
+            if name is not None:
+                self.violations.append(Violation(
+                    pass_name="wire", path=self.path, line=node.lineno,
+                    message=f"in-place arithmetic on int16 array '{name}' "
+                            "without an explicit width cast",
+                    suggestion="widen first (`x.astype(np.int32)`)"))
+        self.generic_visit(node)
+
+
+def _check_scope(scope_node, path: str, violations: list) -> None:
+    scope = _Int16Scope(path, violations)
+    if isinstance(scope_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        scope.add_params(scope_node)
+        for stmt in scope_node.body:
+            scope.visit(stmt)
+    else:
+        for stmt in scope_node.body:
+            scope.visit(stmt)
+
+
+def check_int16_discipline(path: str,
+                           repo_root: Optional[str] = None
+                           ) -> list[Violation]:
+    """Flag arithmetic on int16-typed names without explicit widening."""
+    repo_root = repo_root or _repo_root()
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    violations: list[Violation] = []
+    _check_scope(tree, os.path.relpath(path, repo_root), violations)
+    return violations
+
+
+def check_struct_widths(path: str,
+                        repo_root: Optional[str] = None) -> list[Violation]:
+    """Every struct format: explicit big-endian, fixed-width codes only."""
+    repo_root = repo_root or _repo_root()
+    rel = os.path.relpath(path, repo_root)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func) not in ("struct.Struct", "Struct"):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            out.append(Violation(
+                pass_name="wire", path=rel, line=node.lineno,
+                message="struct.Struct format is not a string literal "
+                        "(width unverifiable)"))
+            continue
+        fmt = node.args[0].value
+        if not fmt.startswith(">"):
+            out.append(Violation(
+                pass_name="wire", path=rel, line=node.lineno,
+                message=f"struct format {fmt!r} is not explicitly "
+                        "big-endian",
+                suggestion="wire structs must start with '>' — native "
+                           "order/size varies by platform"))
+            continue
+        bad = sorted({c for c in fmt[1:]
+                      if not c.isdigit() and c not in _FIXED_WIDTH_CODES})
+        if bad:
+            out.append(Violation(
+                pass_name="wire", path=rel, line=node.lineno,
+                message=f"struct format {fmt!r} uses non-fixed-width "
+                        f"code(s) {bad}",
+                suggestion="use b/B h/H i/I q/Q e/f/d/s/x only"))
+    return out
+
+
+def check_wire(paths: Optional[tuple] = None,
+               repo_root: Optional[str] = None) -> list[Violation]:
+    repo_root = repo_root or _repo_root()
+    paths = paths or tuple(os.path.join(repo_root, p) for p in WIRE_FILES)
+    out: list[Violation] = []
+    for p in paths:
+        out.extend(check_struct_widths(p, repo_root))
+        out.extend(check_int16_discipline(p, repo_root))
+    return out
+
+
+def _repo_root() -> str:
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Repo lint gate: the static contract checker + a pytest collection
+# smoke test (import errors surface here, not mid-CI).
+#
+#   tools/lint.sh            # all fluidlint passes + collection check
+#   tools/lint.sh layers     # just one fluidlint pass
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if [ "$#" -gt 0 ]; then
+    args=()
+    for p in "$@"; do args+=(--pass "$p"); done
+    python -m tools.fluidlint "${args[@]}"
+    exit 0
+fi
+
+python -m tools.fluidlint
+
+echo "--- pytest collection check"
+python -m pytest tests/ -q --collect-only -p no:cacheprovider >/dev/null
+echo "collection: ok"
