@@ -32,6 +32,12 @@ from .definitions import (
     DocumentStorage,
 )
 
+#: chaos seam (fluidframework_tpu/chaos): when set, transports constructed
+#: while installed route outbound frames through the hook for drop /
+#: duplicate / delay / reorder / mid-frame-truncate faults. Captured per
+#: transport at construction so arming cannot race live connections.
+FRAME_FAULT_HOOK = None
+
 
 class _Transport:
     """One framed TCP connection + reader thread + rid-matched requests."""
@@ -52,6 +58,8 @@ class _Transport:
         self.on_binary_ops: Optional[Callable[[list], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._closed = False
+        self._fault = FRAME_FAULT_HOOK
+        self._held: list[bytes] = []  # delayed frames awaiting overtake
         self._idle_windows = 0  # consecutive recv-timeout windows
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True, name="fluid-net-reader")
@@ -60,12 +68,56 @@ class _Transport:
     # ------------------------------------------------------------- sending
 
     def send(self, frame: dict) -> None:
-        self.send_body(json.dumps(frame, separators=(",", ":")).encode())
+        self.send_body(json.dumps(frame, separators=(",", ":")).encode(),
+                       kind=frame.get("t"))
 
-    def send_body(self, body: bytes) -> None:
+    def send_body(self, body: bytes, kind: Optional[str] = None) -> None:
         """Send a length-prefix-framed body (JSON or binwire)."""
+        if self._fault is not None:
+            self._send_with_faults(body, kind)
+            return
         with self._wlock:
             self.sock.sendall(len(body).to_bytes(4, "big") + body)
+
+    def _send_with_faults(self, body: bytes, kind: Optional[str]) -> None:
+        """Chaos-armed send path: consult the fault plane per frame.
+
+        - ``drop``: the frame vanishes (a lost datagram-equivalent; TCP
+          never does this, but a dying proxy/LB absolutely does).
+        - ``dup``: the frame arrives twice (an at-least-once relay).
+        - ``delay``/``reorder``: the frame is held and flushed AFTER the
+          next frame — a later frame overtakes it on the wire.
+        - ``truncate``: half the body is sent under a full-length header,
+          then the connection dies mid-frame — the peer's framed read
+          blocks on bytes that never come and sees the close.
+        """
+        directive = self._fault("net.send", kind=kind, size=len(body))
+        if directive in ("delay", "reorder"):
+            self._held.append(body)
+            return
+        if directive == "truncate":
+            with self._wlock:
+                try:
+                    self.sock.sendall(
+                        len(body).to_bytes(4, "big")
+                        + body[:len(body) // 2])
+                except OSError:
+                    pass
+            self.close()
+            return
+        if directive == "drop":
+            frames = []
+        elif directive == "dup":
+            frames = [body, body]
+        else:
+            frames = [body]
+        with self._wlock:
+            # held (delayed) frames flush AFTER this one: the overtake
+            # IS the reorder
+            frames += self._held
+            self._held = []
+            for b in frames:
+                self.sock.sendall(len(b).to_bytes(4, "big") + b)
 
     def request(self, frame: dict) -> dict:
         """Send a frame with a request id; block for the matching reply."""
@@ -259,7 +311,7 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                     # server accepts both frame kinds on any connection
                     body = None
                 if body is not None:
-                    self._t.send_body(body)
+                    self._t.send_body(body, kind="submit")
                     return
             self._t.send({"t": "submit",
                           "ops": [message_to_dict(m) for m in messages]})

@@ -41,6 +41,11 @@ class BroadcasterLambda:
     per-batch instead of per-op at high throughput.
     """
 
+    #: chaos seam (fluidframework_tpu/chaos): dropped / repeated
+    #: broadcast faults. Class-level because orderers construct their
+    #: broadcaster lazily; None = disarmed, one branch per batch.
+    fault_plane = None
+
     def __init__(self, pubsub: PubSub):
         self._pubsub = pubsub
 
@@ -57,9 +62,19 @@ class BroadcasterLambda:
             batch = envelope.get("boxcar")
         if batch is None:
             batch = [envelope["message"]]
-        self._pubsub.publish(
-            self.topic(envelope["tenant_id"], envelope["document_id"]), batch
-        )
+        topic = self.topic(envelope["tenant_id"], envelope["document_id"])
+        if self.fault_plane is not None:
+            directive = self.fault_plane("broadcast.publish", topic=topic)
+            if directive == "drop":
+                # a lost pub/sub delivery: clients recover through the
+                # delta-storage gap repair when the next op arrives (or
+                # the settle-phase catch-up)
+                return
+            if directive == "dup":
+                # a repeated delivery (pub/sub redelivers after a
+                # timeout): clients dedupe by sequence number
+                self._pubsub.publish(topic, batch)
+        self._pubsub.publish(topic, batch)
 
     def close(self) -> None:
         pass

@@ -235,6 +235,15 @@ class DurableLog(OrderedLogBase):
     def poll(self) -> bool:
         """Refresh every subscribed topic from disk; mark grown topics
         dirty. Returns True when drain() has new work."""
+        if self.fault_plane is not None:
+            # chaos seam, read side: a consumer process resuming from a
+            # stale position (lost position file, conservative restart)
+            # re-reads an already-consumed window — every subscriber
+            # must tolerate redelivery
+            if self.fault_plane("log.poll", directory=self.directory) \
+                    == "rewind":
+                for topic in self._order:
+                    self.rewind_subscribers(topic, 1)
         grew = False
         for topic in self._order:
             n = self._log.refresh(_sanitize(topic))

@@ -876,6 +876,8 @@ class NetworkFrontEnd:
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass  # malformed stream: drop the connection
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # client died mid-frame: treat like a clean close
         finally:
             self._sessions.discard(session)
             session.closed()

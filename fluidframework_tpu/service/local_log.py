@@ -23,6 +23,11 @@ Handler = Callable[[QueuedMessage], None]
 
 
 class OrderedLogBase:
+    #: chaos seam (duck-typed; see fluidframework_tpu/chaos): when armed,
+    #: append() consults it for torn-write / duplicate-delivery /
+    #: replay-from-older-offset faults. None = disarmed, one branch.
+    fault_plane = None
+
     def __init__(self):
         self._subs: dict[str, list[tuple[Handler, list[int]]]] = {}
         self._order: list[str] = []
@@ -52,9 +57,41 @@ class OrderedLogBase:
 
     def append(self, topic: str, value: Any, partition: int = 0) -> int:
         self.create_topic(topic)
+        if self.fault_plane is not None:
+            directive = self.fault_plane("log.append", topic=topic,
+                                         record=value)
+            if directive == "torn":
+                # the write never reached the medium (power cut mid
+                # append; the native log truncates the torn tail on
+                # open) — the producer believes it wrote, consumers
+                # never see it; recovery is the client resubmit path
+                self._dirty[topic] = None
+                return self._stored_length(topic)
+            if directive == "dup":
+                # the record lands twice (producer retry after a lost
+                # ack) — consumers must dedupe (deli by clientSeq,
+                # scriptorium by idempotent upsert, clients by seq)
+                self._store(topic, value)
+            elif directive == "rewind":
+                # replay-from-older-offset: store normally, then drag
+                # every subscriber back one record — redelivery of an
+                # already-consumed window
+                offset = self._store(topic, value)
+                self._dirty[topic] = None
+                self.rewind_subscribers(topic, 1)
+                return offset
         offset = self._store(topic, value)
         self._dirty[topic] = None
         return offset
+
+    def rewind_subscribers(self, topic: str, n: int = 1) -> None:
+        """Move every subscriber position on ``topic`` back ``n``
+        records: the next drain redelivers them (the at-least-once
+        delivery mode every consumer must already tolerate)."""
+        for _, pos in self._subs.get(topic, ()):
+            pos[0] = max(0, pos[0] - n)
+        if self._subs.get(topic):
+            self._dirty[topic] = None
 
     def subscribe(self, topic: str, handler: Handler, from_offset: int = 0) -> None:
         self.create_topic(topic)

@@ -368,6 +368,19 @@ class LocalServer:
             orderer.close()
         self._get_orderer(tenant_id, document_id)
 
+    def crash_orderer(self, tenant_id: str, document_id: str) -> None:
+        """Simulate a kill -9 of the document's pipeline: tear down
+        WITHOUT checkpointing and rebuild from the last durable
+        checkpoint. Deli replays the raw log from its checkpointed
+        offset and re-tickets the window with identical sequence
+        numbers; downstream consumers dedupe by seq (the chaos soak's
+        stage-crash fault)."""
+        key = f"{tenant_id}/{document_id}"
+        orderer = self._orderers.pop(key, None)
+        if orderer is not None:
+            orderer.close()
+        self._get_orderer(tenant_id, document_id)
+
     # ------------------------------------------------------------- internal
 
     def _get_orderer(self, tenant_id: str, document_id: str) -> LocalOrderer:

@@ -36,6 +36,11 @@ def partition_of(tenant_id: str, document_id: str, n_partitions: int) -> int:
 class Partition:
     """One partition's per-document pipelines on its current host."""
 
+    #: chaos seam (fluidframework_tpu/chaos): a crash mid-checkpoint —
+    #: some orderers checkpointed, the rest not — the partial-progress
+    #: window a rebalance-during-crash exposes. None = disarmed.
+    fault_plane = None
+
     def __init__(self, pid: int, log, db, pubsub, clock=None):
         self.pid = pid
         self._log = log
@@ -58,6 +63,10 @@ class Partition:
 
     def checkpoint(self) -> None:
         for o in self.orderers.values():
+            if self.fault_plane is not None:
+                # kill between one doc's checkpoint and the next: the
+                # un-checkpointed docs recover by raw-log replay
+                self.fault_plane("partition.checkpoint", pid=self.pid)
             o.checkpoint()
 
     def close(self, graceful: bool = True) -> None:

@@ -74,6 +74,18 @@ class _StageHostBase:
     #: deltas topics are the stage input; uploads only matter to scribe
     topic_prefixes = ("deltas/",)
 
+    #: chaos seam (fluidframework_tpu/chaos): crash-window faults. The
+    #: plane raises SimulatedCrash from inside the checkpoint sequence —
+    #: between consume and farm save ("stage.pre_checkpoint") or between
+    #: farm save and the offset/emit records ("stage.post_checkpoint") —
+    #: the two windows whose replay/idempotency story must hold on a real
+    #: kill -9. None = disarmed, one branch per checkpoint.
+    fault_plane = None
+
+    def _fault(self, point: str, **ctx) -> None:
+        if self.fault_plane is not None:
+            self.fault_plane(point, stage=type(self).__name__, **ctx)
+
     def __init__(self, log_dir: str, state_dir: str,
                  partition: Optional[tuple] = None):
         self.shared = DurableLog(log_dir, readonly=True)
@@ -193,6 +205,20 @@ class _StageHostBase:
             if not moved:
                 time.sleep(POLL_INTERVAL_S)
 
+    def run_once(self) -> bool:
+        """ONE deterministic iteration of the run_forever loop body:
+        discover, poll, drain, checkpoint, flush. Lets a driver (the
+        chaos soak, a test) step a stage in-process and catch a
+        SimulatedCrash exactly at the armed window. Returns whether the
+        poll found new records."""
+        self.discover()
+        moved = self.shared.poll()
+        if moved:
+            self.shared.drain()
+        self.checkpoint()
+        self.state.flush()
+        return moved
+
     # ------------------------------------------------------------ per-stage
 
     def attach(self, topic: str) -> None:
@@ -276,6 +302,9 @@ class ScribeStage(_StageHostBase):
             self.shared.subscribe(topic, on_upload, from_offset=0)
 
     def checkpoint(self) -> None:
+        # crash window: records consumed, checkpoint not yet written —
+        # a restart replays the window (scribe replay is seq-idempotent)
+        self._fault("stage.pre_checkpoint")
         for key, scribe in self.scribes.items():
             tenant, doc = key.split("/", 1)
             self.save_checkpoint(tenant, doc, {
@@ -360,9 +389,17 @@ class ApplierStage(_StageHostBase):
     def checkpoint(self) -> None:
         from .tpu_applier import save_applier_checkpoint
 
+        # crash window 1: deltas consumed into the farm, nothing saved —
+        # a restart resumes from the OLD offsets and replays the window
+        # (ingest skips by sequence number)
+        self._fault("stage.pre_checkpoint")
         self.applier.flush()
         self.applier.finalize()
         save_applier_checkpoint(self.applier, self._ckpt_path)
+        # crash window 2: the farm is saved but the offset checkpoints /
+        # "applied" emits are not — the restart replays against a NEWER
+        # farm, the skip-by-seq path's hardest case
+        self._fault("stage.post_checkpoint")
         for topic, offset in self._offsets.items():
             tenant, doc = _doc_of(topic)
             self.save_checkpoint(tenant, doc, {"offset": offset})

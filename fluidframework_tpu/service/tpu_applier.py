@@ -204,6 +204,12 @@ def channel_stream(server, tenant_id: str, document_id: str,
 class TpuDocumentApplier:
     """Maintains [D, S] device doc states fed by sequenced op streams."""
 
+    #: chaos seam (fluidframework_tpu/chaos): forced device escalations —
+    #: the int32 wide dispatch path and the overflow-to-host flip — so the
+    #: rare lanes run under the soak, not only when a doc organically
+    #: exceeds int16 / device capacity. None = disarmed, one branch.
+    fault_plane = None
+
     def __init__(
         self,
         max_docs: Optional[int] = None,
@@ -395,6 +401,14 @@ class TpuDocumentApplier:
             if slot in self._restore_applied:
                 self._post_restore_first.setdefault(
                     slot, pairs[0][0].sequence_number)
+        if self.fault_plane is not None and slot not in self._host_docs:
+            if self.fault_plane("applier.ingest", slot=slot) \
+                    == "escalate_host":
+                # forced overflow-to-host flip: same path a doc takes
+                # when it outgrows device capacity — replays the
+                # authoritative log into a host replica, then applies
+                # this batch host-side below
+                self._escalate(slot, None, None)
         if slot in self._host_docs:
             for msg, wire_op in pairs:
                 self._apply_host(slot, msg, wire_op)
@@ -735,7 +749,11 @@ class TpuDocumentApplier:
         packed[:, F_KEY] = flat[:, F_KEY]
         packed[:, F_VAL] = flat[:, F_VAL]
 
-        if (packed.min() >= -32768) and (packed.max() <= 32767):
+        force_wide = (
+            self.fault_plane is not None
+            and self.fault_plane("applier.dispatch", ops=n) == "force_wide")
+        if not force_wide \
+                and (packed.min() >= -32768) and (packed.max() <= 32767):
             wave16 = np.zeros((self.max_docs, K, OP_FIELDS), np.int16)
             wave16[doc_idx, pos_idx] = packed.astype(np.int16)
             bases = np.zeros((self.max_docs, 2), np.int32)

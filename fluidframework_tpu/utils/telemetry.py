@@ -15,6 +15,7 @@ aggregation is in-process and queryable.
 
 from __future__ import annotations
 
+import random
 import time
 from collections import defaultdict
 from typing import Any, Callable, Optional
@@ -111,27 +112,51 @@ class PerformanceEvent:
 
 
 class Counters:
-    """Named monotonic counters + value observations (metricClient role)."""
+    """Named monotonic counters + value observations (metricClient role).
 
-    def __init__(self):
+    Value series are bounded: each keeps a ``max_samples`` reservoir
+    (uniform reservoir sampling, seeded so snapshots are reproducible)
+    plus the true observation count — a long-running service observing
+    per-op latencies must not grow a list per op forever. ``count`` in
+    the snapshot is always the TRUE number of observations, not the
+    reservoir size.
+    """
+
+    def __init__(self, max_samples: int = 4096):
         self._counts: dict[str, int] = defaultdict(int)
         self._values: dict[str, list[float]] = defaultdict(list)
+        self._observed: dict[str, int] = defaultdict(int)
+        self._max_samples = max_samples
+        self._rng = random.Random(0)
 
     def inc(self, name: str, by: int = 1) -> None:
         self._counts[name] += by
 
     def observe(self, name: str, value: float) -> None:
-        self._values[name].append(value)
+        n = self._observed[name] = self._observed[name] + 1
+        vals = self._values[name]
+        if len(vals) < self._max_samples:
+            vals.append(value)
+        else:
+            j = self._rng.randrange(n)
+            if j < self._max_samples:
+                vals[j] = value
 
     def snapshot(self) -> dict:
         out: dict[str, Any] = dict(self._counts)
         for name, vals in self._values.items():
             s = sorted(vals)
-            out[name] = {
-                "count": len(s),
+            series: dict[str, Any] = {
+                "count": self._observed[name],
                 "p50": round(percentile(s, 0.5), 3),
                 "p99": round(percentile(s, 0.99), 3),
             }
+            if name in self._counts:
+                # a counter and a value series share the name: surface
+                # both under the key instead of the series silently
+                # clobbering the counter (or vice versa)
+                series["counter"] = self._counts[name]
+            out[name] = series
         return out
 
 

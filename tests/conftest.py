@@ -17,3 +17,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, excluded from the tier-1 `-m 'not slow'` "
+        "run (multi-seed soaks, network stress)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: exercises the fault-injection plane "
+        "(fluidframework_tpu/chaos); `-m chaos` selects the chaos suite")

@@ -69,6 +69,36 @@ def test_counters_snapshot_percentiles():
     assert 45 <= snap["lat"]["p50"] <= 55
 
 
+def test_counters_name_collision_surfaces_both():
+    """A counter and a value series sharing a name must both survive the
+    snapshot — the series reports under the key with the counter beside
+    it instead of one silently clobbering the other."""
+    c = Counters()
+    c.inc("chaos.injected", 2)
+    c.observe("chaos.injected", 1.5)
+    c.observe("chaos.injected", 2.5)
+    snap = c.snapshot()
+    assert snap["chaos.injected"]["count"] == 2
+    assert snap["chaos.injected"]["counter"] == 2
+    assert snap["chaos.injected"]["p50"] in (1.5, 2.5)
+
+
+def test_counters_reservoir_is_bounded_and_deterministic():
+    c = Counters(max_samples=64)
+    for v in range(10_000):
+        c.observe("lat", float(v))
+    assert len(c._values["lat"]) == 64  # bounded, not 10k
+    snap = c.snapshot()
+    assert snap["lat"]["count"] == 10_000  # true total, not reservoir size
+    # uniform reservoir: p50 lands near the middle of the range
+    assert 2_000 <= snap["lat"]["p50"] <= 8_000
+    # seeded: a second identical run snapshots identically
+    c2 = Counters(max_samples=64)
+    for v in range(10_000):
+        c2.observe("lat", float(v))
+    assert c2.snapshot() == snap
+
+
 def _msg(traces):
     return SequencedDocumentMessage(
         client_id="c", sequence_number=1, minimum_sequence_number=0,
@@ -86,6 +116,43 @@ def test_trace_aggregator_per_hop_split():
     rep = agg.report()
     assert abs(rep["submit_to_deli"]["p50_ms"] - 4.0) < 0.01
     assert abs(rep["deli_to_ack"]["p50_ms"] - 6.0) < 0.01
+
+
+def test_trace_aggregator_missing_hops():
+    """Partial stamping must not poison the split: no deli hop → nothing
+    recorded; a deli hop without the client submit hop still yields the
+    deli→ack leg (the server stamped it, the client didn't)."""
+    agg = TraceAggregator()
+    agg.record(_msg([TraceHop("client", "submit", 1000.0)]),
+               ack_time=1000.5)
+    assert agg.report() == {}
+    agg.record(_msg([TraceHop("deli", "sequence", 1000.0)]),
+               ack_time=1000.002)
+    rep = agg.report()
+    assert "submit_to_deli" not in rep
+    assert rep["deli_to_ack"]["count"] == 1
+    agg.record(_msg([]), ack_time=1001.0)  # no traces at all: a no-op
+    assert agg.report()["deli_to_ack"]["count"] == 1
+
+
+def test_trace_aggregator_merge_raw_and_percentiles():
+    """merge_raw folds a worker's raw hop lists into the parent (the
+    cross-process aggregation path) and report() percentiles span the
+    merged population."""
+    a, b = TraceAggregator(), TraceAggregator()
+    t0 = 2000.0
+    for i in range(10):
+        a.record(_msg([TraceHop("client", "submit", t0),
+                       TraceHop("deli", "sequence", t0 + 0.001 * (i + 1))]),
+                 ack_time=t0 + 0.05)
+    b.merge_raw(a.raw)
+    b.merge_raw({"submit_to_deli": [100.0], "custom_hop": [7.0]})
+    rep = b.report()
+    assert rep["submit_to_deli"]["count"] == 11
+    assert rep["custom_hop"] == {"count": 1, "p50_ms": 7.0, "p99_ms": 7.0}
+    # p50 from the 1..10ms ramp; p99 pulled up by the merged outlier
+    assert 4.0 <= rep["submit_to_deli"]["p50_ms"] <= 7.0
+    assert rep["submit_to_deli"]["p99_ms"] == 100.0
 
 
 def test_deli_stamps_ride_to_clients_and_aggregate():

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# CI gate: static contracts, import health, and a deterministic chaos
+# smoke — everything a commit must survive before the full test run.
+#
+#   tools/ci.sh              # fluidlint + collection check + chaos soak
+#   tools/ci.sh --no-soak    # skip the soak (doc-only changes)
+#
+# The soak runs the seeded fault campaign at a FIXED seed so a CI
+# failure reproduces exactly with the same command locally:
+#   python -m fluidframework_tpu.chaos.soak --seed 0 --quick
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+run_soak=1
+if [ "${1:-}" = "--no-soak" ]; then
+    run_soak=0
+fi
+
+echo "--- fluidlint (static contracts)"
+python -m tools.fluidlint
+
+echo "--- pytest collection check"
+python -m pytest tests/ -q --collect-only -p no:cacheprovider >/dev/null
+echo "collection: ok"
+
+if [ "$run_soak" = 1 ]; then
+    echo "--- chaos soak (fixed seed, quick)"
+    python -m fluidframework_tpu.chaos.soak --seed 0 --quick
+    echo "soak: ok"
+fi
+
+echo "ci: all gates passed"

@@ -64,6 +64,12 @@ ALLOWED = {
     "native": {"utils"},
     "replay": {"loader", "driver", "runtime", "dds", "protocol", "utils",
                "service", "mergetree"},
+    # the fault-injection plane sits beside the service: it may reach the
+    # seams it arms (service/driver) and the layers they expose, but NO
+    # production layer may import chaos back — the seams stay duck-typed
+    # (`fault_plane = None` class attrs / module hooks), so disarmed code
+    # has no chaos dependency at all; only tests and the soak import it
+    "chaos": {"service", "driver", "mergetree", "protocol", "utils"},
 }
 
 #: One-line role per layer, used by the PACKAGES.md generator.
@@ -81,6 +87,7 @@ LAYER_DOC = {
     "service": "deli, scriptorium, scribe, TPU applier, front end",
     "native": "C++ durable op log + chunk store bindings",
     "replay": "replay tool + snapshot-regression corpus",
+    "chaos": "deterministic fault injection + convergence invariant monitor",
 }
 
 
