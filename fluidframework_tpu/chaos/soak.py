@@ -471,11 +471,13 @@ class NetSoakClient:
     dropped / duplicated / reordered / cut mid-frame."""
 
     def __init__(self, service, monitor: InvariantMonitor,
-                 counters: Counters, rng: random.Random):
+                 counters: Counters, rng: random.Random,
+                 coalesce_window: float | None = None):
         self.service = service
         self.monitor = monitor
         self.counters = counters
         self.rng = rng
+        self.coalesce_window = coalesce_window
         self.replica: MergeTreeClient | None = None
         self.conn = None
         self.cseq = 0
@@ -488,6 +490,10 @@ class NetSoakClient:
 
     def connect(self) -> None:
         conn = self.service.connect_to_delta_stream()
+        if self.coalesce_window is not None:
+            # force the driver's ingress coalescer on so the fault plane
+            # exercises MULTI-OP boxcars, not just per-op frames
+            conn.coalesce_window = self.coalesce_window
         self.conn = conn
         self.dead = False
         self.nacked = False
@@ -521,8 +527,9 @@ class NetSoakClient:
         with self.conn.lock:
             wire_ops = [op_to_wire(op)
                         for op in self.replica.regenerate_pending_ops()]
-        for w in wire_ops:
-            self._submit_wire(w)
+        # resubmit as ONE boxcar: the recovery path must survive the
+        # same coalesced-frame faults the original submissions do
+        self._submit_wires(wire_ops)
 
     def _on_op(self, m) -> None:
         # runs on the reader thread, under the connection lock
@@ -552,13 +559,21 @@ class NetSoakClient:
         if cseq is not None:
             self.unresolved = [c for c in self.unresolved if c != cseq]
 
-    def _submit_wire(self, wire_op: dict) -> None:
-        self.cseq += 1
-        self.monitor.note_submit(self.conn.client_id, self.cseq)
-        self.unresolved.append(self.cseq)
+    def _submit_wires(self, wire_ops: list) -> None:
+        """Submit a round's ops as ONE multi-op boxcar frame — the
+        coalesced shape the fault plane must tear, duplicate and reorder
+        without breaking convergence."""
+        if not wire_ops:
+            return
+        msgs = []
+        for w in wire_ops:
+            self.cseq += 1
+            self.monitor.note_submit(self.conn.client_id, self.cseq)
+            self.unresolved.append(self.cseq)
+            msgs.append(_chan_msg(
+                self.cseq, self.replica.tree.current_seq, w))
         try:
-            self.conn.submit([_chan_msg(
-                self.cseq, self.replica.tree.current_seq, wire_op)])
+            self.conn.submit(msgs)
         except OSError:
             self.dead = True
 
@@ -567,6 +582,7 @@ class NetSoakClient:
             return
         rng = self.rng
         with self.conn.lock:
+            wires = []
             for _ in range(n_ops):
                 length = self.replica.get_length()
                 if length > 4 and rng.random() < 0.3:
@@ -579,7 +595,8 @@ class NetSoakClient:
                     text = _TEXT_POOL[off:off + 1 + rng.randrange(6)]
                     op = self.replica.insert_text_local(
                         rng.randrange(length + 1), text)
-                self._submit_wire(op_to_wire(op))
+                wires.append(op_to_wire(op))
+            self._submit_wires(wires)
 
     @property
     def settled(self) -> bool:
@@ -611,8 +628,10 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
     try:
         clients = [
             NetSoakClient(
-                NetworkDocumentService("127.0.0.1", front.port, TENANT, DOC),
-                monitor, counters, random.Random(seed * 7000 + i))
+                NetworkDocumentService("127.0.0.1", front.port, TENANT,
+                                       DOC, counters=counters),
+                monitor, counters, random.Random(seed * 7000 + i),
+                coalesce_window=0.02)
             for i in range(n_clients)]
         rng = random.Random(seed + 2)
         for _ in range(rounds):
@@ -651,6 +670,14 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
             raise InvariantViolation(
                 f"phase B observed only {monitor.observed} sequenced "
                 "messages — the workload did not run")
+        snap = counters.snapshot()
+        frames = snap.get("driver.submit.frames", 0)
+        ops = snap.get("driver.submit.ops", 0)
+        if not frames or ops <= frames:
+            raise InvariantViolation(
+                "phase B never drove a multi-op boxcar through the "
+                f"fault plane (frames={frames}, ops={ops}) — the "
+                "coalesced submit path went unexercised")
         for c in clients:
             c.conn.close()
     finally:

@@ -11,6 +11,16 @@ signal) into the client callbacks under ``self.lock``; submits take the
 same lock, so the client replica never interleaves a local submit with a
 remote dispatch. Request/response calls (deltas, storage) ride the same
 connection, matched by request id.
+
+Ingress coalescing: binary submits pass through an adaptive window —
+ops submitted within the window (or while a send is in flight) merge
+into ONE binwire boxcar frame, so a hot client pays one sendall + one
+server-side parse per wave instead of per op. The window self-tunes
+from observed ack latency (EWMA over own-op round trips): an idle or
+fast client sees window 0 and keeps the old inline sub-millisecond
+submit; only a client whose acks already take milliseconds trades a
+fraction of that latency for frame amortization. Set
+``conn.coalesce_window`` to force a fixed window (tests, soak).
 """
 
 from __future__ import annotations
@@ -19,11 +29,13 @@ import itertools
 import json
 import socket
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from ..protocol import binwire
 from ..protocol.messages import MessageType
 from ..protocol.serialization import message_from_dict, message_to_dict
+from ..utils.telemetry import Counters
 from .definitions import (
     DocumentDeltaConnection,
     DocumentDeltaStorage,
@@ -37,6 +49,17 @@ from .definitions import (
 #: duplicate / delay / reorder / mid-frame-truncate faults. Captured per
 #: transport at construction so arming cannot race live connections.
 FRAME_FAULT_HOOK = None
+
+#: binwire boxcars carry a u16 op count; chunk well below it so the
+#: string pool of a pathological wave cannot overflow either
+_MAX_BOXCAR_OPS = 60000
+
+#: adaptive-window tuning: below this observed ack latency the client
+#: counts as fast/idle and submits inline (window 0); above it the
+#: window is ack_ewma/8 capped here — always a small fraction of the
+#: latency the client is already paying
+_COALESCE_MIN_ACK_S = 0.005
+_COALESCE_MAX_WINDOW_S = 0.004
 
 
 class _Transport:
@@ -234,18 +257,30 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def __init__(self, transport: _Transport, tenant_id: str,
                  document_id: str, details: Any = None,
                  token: Optional[str] = None, binary: bool = True,
-                 cache=None):
+                 cache=None, counters: Optional[Counters] = None):
         self._t = transport
         self.lock = transport.lock
         self._binary = binary
         self._tenant = tenant_id
         self._doc = document_id
         self._cache = cache
+        self.counters = counters if counters is not None else Counters()
         self._handlers: dict[str, Optional[Callable]] = {
             "op": None, "nack": None, "signal": None}
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
         self.on_disconnect = None
         self._disc_fired = False
+        #: None = adaptive (tuned from ack latency); a float forces a
+        #: fixed coalescing window in seconds (0.0 = always inline)
+        self.coalesce_window: Optional[float] = None
+        self._coal_cv = threading.Condition(threading.Lock())
+        self._pending_ops: list = []
+        self._send_inflight = False
+        self._flush_deadline: Optional[float] = None
+        self._flusher: Optional[threading.Thread] = None
+        self._coal_closed = False
+        self._inflight_ts: dict[int, float] = {}  # own cseq → submit time
+        self._ack_ewma: Optional[float] = None
 
         def on_ops(f):
             for d in f["msgs"]:
@@ -274,6 +309,16 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         self.max_message_size = reply.get("maxMessageSize")
 
     def _deliver(self, kind: str, event) -> None:
+        if kind == "op" \
+                and getattr(event, "client_id", None) == getattr(
+                    self, "client_id", None):
+            # own op came back sequenced: close the ack-latency loop the
+            # adaptive coalescing window tunes from
+            t0 = self._inflight_ts.pop(event.client_sequence_number, None)
+            if t0 is not None:
+                dt = time.monotonic() - t0
+                e = self._ack_ewma
+                self._ack_ewma = dt if e is None else e + 0.25 * (dt - e)
         if kind == "op" and self._cache is not None \
                 and event.type == MessageType.SUMMARY_ACK:
             # a newer summary committed: the cached boot snapshot is
@@ -301,20 +346,115 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                          lambda self, cb: self._set_handler("signal", cb))
 
     def submit(self, messages) -> None:
-        with self._t.lock:
-            if self._binary:
-                try:
-                    body = binwire.encode_submit(messages)
-                except Exception:
-                    # a boxcar binwire cannot pack (>u16 ops, int outside
-                    # the fixed-field range) still goes through: the
-                    # server accepts both frame kinds on any connection
-                    body = None
+        messages = list(messages)
+        if not messages:
+            return
+        if not self._binary:
+            with self._t.lock:
+                self._t.send({"t": "submit",
+                              "ops": [message_to_dict(m) for m in messages]})
+            return
+        cseq = getattr(messages[-1], "client_sequence_number", None)
+        if cseq is not None:
+            if len(self._inflight_ts) > 256:
+                self._inflight_ts.clear()
+            self._inflight_ts[cseq] = time.monotonic()
+        with self._coal_cv:
+            if self._coal_closed:
+                raise OSError("delta connection closed")
+            if self._pending_ops:
+                self.counters.inc("driver.submit.coalesced", len(messages))
+            self._pending_ops.extend(messages)
+            if self._send_inflight:
+                # the in-flight flush drains the buffer before it parks:
+                # these ops ride the next boxcar without a new wakeup
+                return
+            window = self._window()
+            if window > 0.0:
+                if self._flush_deadline is None:
+                    self._flush_deadline = time.monotonic() + window
+                self._ensure_flusher()
+                self._coal_cv.notify_all()
+                return
+            self._send_inflight = True
+        self._drain_and_send()
+
+    def _window(self) -> float:
+        w = self.coalesce_window
+        if w is not None:
+            return w
+        e = self._ack_ewma
+        if e is None or e < _COALESCE_MIN_ACK_S:
+            return 0.0
+        return min(_COALESCE_MAX_WINDOW_S, e * 0.125)
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or not self._flusher.is_alive():
+            self._flusher = threading.Thread(
+                target=self._flusher_loop, daemon=True,
+                name="fluid-net-coalesce")
+            self._flusher.start()
+
+    def _flusher_loop(self) -> None:
+        while True:
+            with self._coal_cv:
+                if self._coal_closed:
+                    return
+                d = self._flush_deadline
+                if d is None or self._send_inflight \
+                        or not self._pending_ops:
+                    self._coal_cv.wait(0.1)
+                    continue
+                now = time.monotonic()
+                if now < d:
+                    self._coal_cv.wait(d - now)
+                    continue
+                self._send_inflight = True
+            try:
+                self._drain_and_send()
+            except OSError:
+                # peer gone mid-flush: the reader thread sees the dead
+                # socket and runs the disconnect path; pending ops are
+                # the caller's to resubmit after reconnect
+                pass
+
+    def _drain_and_send(self) -> None:
+        """Flush the coalescing buffer, then keep draining anything that
+        arrived while the send was on the wire. Runs with
+        ``_send_inflight`` held; always releases it."""
+        try:
+            while True:
+                with self._coal_cv:
+                    ops = self._pending_ops
+                    self._flush_deadline = None
+                    if not ops:
+                        return
+                    self._pending_ops = []
+                self._send_ops(ops)
+        finally:
+            with self._coal_cv:
+                self._send_inflight = False
+                self._coal_cv.notify_all()
+
+    def _send_ops(self, ops: list) -> None:
+        for i in range(0, len(ops), _MAX_BOXCAR_OPS):
+            chunk = ops[i:i + _MAX_BOXCAR_OPS]
+            try:
+                body = binwire.encode_submit(chunk)
+            except Exception:
+                # a boxcar binwire cannot pack (>u16 ops, int outside
+                # the fixed-field range) still goes through: the
+                # server accepts both frame kinds on any connection
+                body = None
+            with self._t.lock:
                 if body is not None:
                     self._t.send_body(body, kind="submit")
-                    return
-            self._t.send({"t": "submit",
-                          "ops": [message_to_dict(m) for m in messages]})
+                else:
+                    self._t.send(
+                        {"t": "submit",
+                         "ops": [message_to_dict(m) for m in chunk]})
+            self.counters.inc("driver.submit.frames")
+            self.counters.inc("driver.submit.ops", len(chunk))
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})
@@ -331,6 +471,20 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             self.on_disconnect(reason)
 
     def close(self) -> None:
+        # drain the coalescing window first: close must not drop submits
+        # the caller already handed over
+        with self._coal_cv:
+            self._coal_closed = True
+            deadline = time.monotonic() + 0.5
+            while self._send_inflight and time.monotonic() < deadline:
+                self._coal_cv.wait(0.05)
+            pending, self._pending_ops = self._pending_ops, []
+            self._coal_cv.notify_all()
+        if pending:
+            try:
+                self._send_ops(pending)
+            except OSError:
+                pass
         try:
             self._t.send({"t": "disconnect"})
         except OSError:
@@ -444,13 +598,15 @@ class NetworkDocumentService(DocumentService):
 
     def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
                  timeout: float = 30.0, token_provider=None,
-                 binary: bool = True, cache=None):
+                 binary: bool = True, cache=None,
+                 counters: Optional[Counters] = None):
         self._host, self._port, self._timeout = host, port, timeout
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
         self._binary = binary
         self._cache = cache
+        self.counters = counters if counters is not None else Counters()
         self._rpc: Optional[_Transport] = None
 
     def _rpc_transport(self) -> _Transport:
@@ -464,7 +620,8 @@ class NetworkDocumentService(DocumentService):
                  if self._token_provider else None)
         return NetworkDeltaConnection(t, self._tenant, self._doc, details,
                                       token=token, binary=self._binary,
-                                      cache=self._cache)
+                                      cache=self._cache,
+                                      counters=self.counters)
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
         return NetworkDeltaStorage(self._rpc_transport(), self._tenant,
@@ -483,7 +640,8 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  token_provider=None, binary: bool = True,
-                 snapshot_cache: bool = True):
+                 snapshot_cache: bool = True,
+                 counters: Optional[Counters] = None):
         from .snapshot_cache import SnapshotCache
 
         self._host, self._port, self._timeout = host, port, timeout
@@ -493,6 +651,9 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
         # odspCache shape); reachable as factory.snapshot_cache for
         # stats/assertions
         self.snapshot_cache = SnapshotCache() if snapshot_cache else None
+        # one Counters shared by every connection of this factory, so
+        # bench/soak/tests can assert submit coalescing engaged
+        self.counters = counters if counters is not None else Counters()
 
     def create_document_service(
         self, tenant_id: str, document_id: str
@@ -500,4 +661,4 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
         return NetworkDocumentService(
             self._host, self._port, tenant_id, document_id, self._timeout,
             token_provider=self._token_provider, binary=self._binary,
-            cache=self.snapshot_cache)
+            cache=self.snapshot_cache, counters=self.counters)

@@ -123,9 +123,16 @@ def _encode_binary(value: Any) -> bytes | None:
     t = type(value)
     if t is ArrayBoxcar:
         return bytes((_BIN_MARK, _BIN_RAW_ABOX)) + _abox_bytes(value)
-    if t is dict and len(value) == 3:
+    if t is dict and value.keys() == {"tenant_id", "document_id",
+                                      "abatch"}:
         batch = value.get("abatch")
-        if type(batch) is SequencedArrayBatch:
+        # the decoder reconstructs tenant_id/document_id FROM the boxcar,
+        # so the binary path is only sound when the dict's fields equal
+        # the boxcar's — any other record shape (renamed key, divergent
+        # routing field) must round-trip through JSON verbatim
+        if type(batch) is SequencedArrayBatch \
+                and value["tenant_id"] == batch.boxcar.tenant_id \
+                and value["document_id"] == batch.boxcar.document_id:
             import struct
 
             import numpy as np

@@ -59,6 +59,7 @@ from typing import Any, Optional
 from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType
 from ..protocol.serialization import message_from_dict, message_to_dict
+from ..utils.telemetry import Counters
 from .local_server import LocalServer, ServerConnection
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
@@ -88,6 +89,21 @@ async def _read_body(reader: asyncio.StreamReader) -> Optional[bytes]:
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     body = await _read_body(reader)
     return None if body is None else json.loads(body.decode())
+
+
+def _frame_buffered(reader: asyncio.StreamReader) -> bool:
+    """True when a COMPLETE frame already sits in the stream buffer.
+
+    The drain-batched read loops peek here: ``readexactly`` completes
+    synchronously (no event-loop yield) when the buffer holds the bytes,
+    so frames the kernel delivered in one wave are handled as one batch
+    while flow control stays with the public StreamReader API. Reaching
+    into ``_buffer`` is an asyncio-internal dependency, so fail safe:
+    no buffer attribute means no batching, never an error."""
+    buf = getattr(reader, "_buffer", None)
+    if buf is None or len(buf) < 4:
+        return False
+    return len(buf) - 4 >= int.from_bytes(buf[:4], "big")
 
 
 class _ClientSession:
@@ -150,43 +166,53 @@ class _ClientSession:
             pass  # transport torn down mid-shutdown; peer is gone anyway
 
     def _push_op_batch(self, batch: list) -> None:
-        """Encode a broadcast batch ONCE for all its subscribers.
+        """Encode a broadcast batch ONCE per format for all subscribers.
 
         The broadcaster delivers the same batch object to every session
         of the doc back to back; a one-entry cache on the front end keyed
         by (doc, first seq, len) — unique in an append-only stream —
-        turns per-subscriber encoding into a single encode + N raw
-        writes. Binary-negotiated sessions get the binwire encoding from
-        a second cache (a JSON and a binary client can share a doc)."""
+        holds one slot per wire format, so the whole fan-out costs one
+        binwire encode (+ one JSON encode ONLY if a legacy subscriber or
+        an unpackable batch needs it) and N raw writes. ``False`` in the
+        binary slot marks a batch that tried binwire and cannot pack
+        (int outside the fixed-field range, >u16 batch) — every binary
+        session then shares the JSON frame instead of re-attempting."""
         conn = self.conn
+        front = self.front
         key = (conn.tenant_id, conn.document_id,
                batch[0].sequence_number, len(batch))
+        cached_key, slots = front._batch_cache
+        if cached_key != key:
+            slots = [None, None]  # [binwire raw | False, JSON raw]
+            front._batch_cache = (key, slots)
         if self.binary:
-            cached_key, raw = self.front._batch_cache_bin
-            if cached_key != key:
+            raw = slots[0]
+            if raw is None:
                 try:
                     body = None
-                    ctx = self.front._splice_ctx
+                    ctx = front._splice_ctx
                     if ctx is not None:
                         body = binwire.encode_ops_spliced(batch, *ctx)
                     if body is None:
                         body = binwire.encode_ops(batch)
                     raw = binwire.frame(body)
                 except Exception:
-                    # a message binwire cannot pack (int outside the
-                    # fixed-field range, >u16 batch) must not break the
-                    # broadcast — binary clients dispatch JSON ops
-                    # frames too, so fall back per batch
-                    raw = None
-                self.front._batch_cache_bin = (key, raw)
-            if raw is not None:
+                    raw = False
+                slots[0] = raw
+                front.counters.inc("net.fanout.encodes")
+            else:
+                front.counters.inc("net.fanout.cache_hits")
+            if raw is not False:
                 self.push_raw(raw)
                 return
-        cached_key, raw = self.front._batch_cache
-        if cached_key != key:
+        raw = slots[1]
+        if raw is None:
             raw = _encode_frame(
                 {"t": "ops", "msgs": [message_to_dict(m) for m in batch]})
-            self.front._batch_cache = (key, raw)
+            slots[1] = raw
+            front.counters.inc("net.fanout.encodes")
+        else:
+            front.counters.inc("net.fanout.cache_hits")
         self.push_raw(raw)
 
     def push_raw(self, raw: bytes) -> None:
@@ -213,6 +239,7 @@ class _ClientSession:
                 conn = server.connect(
                     frame["tenant"], frame["doc"], frame.get("details"),
                     token=frame.get("token"))
+                self.front._dirty_servers.add(server)  # join was appended
                 self.conn = conn
                 self.binary = bool(frame.get("bin"))
                 # a broadcast batch rides the wire as ONE frame — at load
@@ -239,6 +266,7 @@ class _ClientSession:
                     [message_from_dict(d) for d in frame["ops"]], None, None)
                 if ops:
                     self.conn.submit(ops)
+                    self.front._dirty_servers.add(self.conn.server)
             elif t == "signal":
                 if self.conn is None:
                     raise RuntimeError("signal before connect")
@@ -246,6 +274,7 @@ class _ClientSession:
                                         frame.get("type", "signal"))
             elif t == "disconnect":
                 if self.conn is not None:
+                    self.front._dirty_servers.add(self.conn.server)
                     self.conn.disconnect()
                     self.conn = None
             elif t == "get_deltas":
@@ -263,7 +292,8 @@ class _ClientSession:
             elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
                 self._handle_gateway(t, frame, rid)
             elif t in ("admin_status", "admin_docs", "admin_tenants",
-                       "admin_tenant_add", "admin_tenant_remove"):
+                       "admin_counters", "admin_tenant_add",
+                       "admin_tenant_remove"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -296,6 +326,7 @@ class _ClientSession:
                         self.conn.submit(ops)
                     finally:
                         self.front._splice_ctx = None
+                    self.front._dirty_servers.add(self.conn.server)
             elif ftype == binwire.FT_FSUBMIT:
                 sid, ops, spans, blob, npool = binwire.decode_submit(
                     body, with_spans=True)
@@ -307,6 +338,7 @@ class _ClientSession:
                         conn.submit(ops)
                     finally:
                         self.front._splice_ctx = None
+                    self.front._dirty_servers.add(conn.server)
             else:
                 raise ValueError(f"unexpected binary frame type {ftype}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
@@ -396,6 +428,9 @@ class _ClientSession:
                             except Exception:
                                 raw = None  # unpackable: JSON fallback
                             self.front._fops_cache = (key, raw)
+                            self.front.counters.inc("net.fanout.encodes")
+                        else:
+                            self.front.counters.inc("net.fanout.cache_hits")
                         if raw is not None:
                             self.push_raw(raw)
                         else:
@@ -417,6 +452,7 @@ class _ClientSession:
                                         f"signal/{tenant}/{doc}", server)
             conn = server.connect(tenant, doc, frame.get("details"),
                                   token=frame.get("token"))
+            self.front._dirty_servers.add(server)  # join was appended
             self._fsessions[sid] = conn
             self._fsession_topics[sid] = topic
             self._ftopic_refs[topic] = self._ftopic_refs.get(topic, 0) + 1
@@ -443,6 +479,7 @@ class _ClientSession:
                 frame["sid"])
             if ops:
                 conn.submit(ops)
+                self.front._dirty_servers.add(conn.server)
         elif t == "fsignal":
             conn = self._fsessions[frame["sid"]]
             conn.submit_signal(frame["content"], frame.get("type", "signal"))
@@ -450,6 +487,7 @@ class _ClientSession:
             sid = frame["sid"]
             conn = self._fsessions.pop(sid, None)
             if conn is not None:
+                self.front._dirty_servers.add(conn.server)
                 conn.disconnect()
             topic = self._fsession_topics.pop(sid, None)
             if topic is not None:
@@ -517,6 +555,13 @@ class _ClientSession:
             raise PermissionError(
                 "admin surface requires --admin-secret on a secured "
                 "deployment")
+        if secret is None and t in ("admin_tenant_add",
+                                    "admin_tenant_remove"):
+            # no open bootstrap: on a secret-less deployment ANY client
+            # could otherwise register the first tenant, flip tenancy to
+            # enforcing, and lock every other client out
+            raise PermissionError(
+                "mutating admin calls require --admin-secret")
         if t == "admin_status":
             tenant, doc = frame["tenant"], frame["doc"]
             server = front.server_for(tenant, doc)
@@ -552,6 +597,11 @@ class _ClientSession:
             self.push("admin", {
                 "rid": rid,
                 "tenants": tenants.list_tenants() if tenants else []})
+        elif t == "admin_counters":
+            # read-only: the socket-tier batching counters, so bench and
+            # soak can assert coalescing/flush-eliding actually engaged
+            self.push("admin", {"rid": rid,
+                                "counters": front.counters.snapshot()})
         elif t == "admin_tenant_add":
             if tenants is None:
                 from .tenants import TenantManager
@@ -606,9 +656,11 @@ class _ClientSession:
 
     def closed(self) -> None:
         if self.conn is not None:
+            self.front._dirty_servers.add(self.conn.server)
             self.conn.disconnect()
             self.conn = None
         for conn in self._fsessions.values():
+            self.front._dirty_servers.add(conn.server)
             conn.disconnect()
         self._fsessions.clear()
         self._fsession_topics.clear()
@@ -794,9 +846,16 @@ class NetworkFrontEnd:
         self.max_message_size = (
             max_message_size if max_message_size is not None
             else self.server.config.max_message_size)
-        self._batch_cache: tuple = (None, b"")
-        self._batch_cache_bin: tuple = (None, b"")
+        # (key, [binwire raw | False, JSON raw]) — one entry, one slot
+        # per wire format (see _ClientSession._push_op_batch)
+        self._batch_cache: tuple = (None, [None, None])
         self._fops_cache: tuple = (None, b"")
+        # socket-tier batching telemetry (net.ingress.*, net.flush.*,
+        # net.fanout.*), served read-only by the admin_counters RPC
+        self.counters = Counters()
+        # partition servers dirtied by the current ingress batch; the
+        # batch flushes exactly these (see _flush_dirty)
+        self._dirty_servers: set = set()
         # splice context of the binary submit currently on the stack
         # (handle_binary sets it around conn.submit)
         self._splice_ctx: Optional[tuple] = None
@@ -840,9 +899,37 @@ class NetworkFrontEnd:
             if hasattr(server.log, "flush"):
                 server.log.flush()
 
+    def _flush_dirty(self) -> None:
+        """Flush only the logs the current ingress batch dirtied.
+
+        The old per-frame path flushed EVERY partition's log on every
+        frame — at 2 cores each frame paid for all shards (the sharded
+        regression's prime suspect). Read-only batches (pings, storage
+        RPCs, signals) flush nothing at all."""
+        dirty = self._dirty_servers
+        n_all = (len(self.shard_host.servers)
+                 if self.shard_host is not None else 1)
+        if not dirty:
+            self.counters.inc("net.flush.elided", n_all)
+            return
+        flushed = 0
+        for server in dirty:
+            log = server.log
+            if hasattr(log, "flush"):
+                try:
+                    log.flush()
+                except OSError:
+                    continue  # partition revoked mid-teardown
+                flushed += 1
+        dirty.clear()
+        self.counters.inc("net.flush.performed", flushed)
+        if n_all > flushed:
+            self.counters.inc("net.flush.elided", n_all - flushed)
+
     def _drop_server_sessions(self, server) -> None:
         """Close every live session bound to a revoked partition server
         (runs on the loop thread via call_soon_threadsafe)."""
+        self._dirty_servers.discard(server)
         for session in list(self._sessions):
             try:
                 session.drop_server(server)
@@ -859,20 +946,38 @@ class NetworkFrontEnd:
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         session = _ClientSession(self, writer)
         self._sessions.add(session)
+        counters = self.counters
         try:
             while True:
                 body = await _read_body(reader)
                 if body is None:
                     break
-                if binwire.is_binary(body):
-                    session.handle_binary(body)
-                else:
-                    session.handle(json.loads(body.decode()))
+                # drain-batched serving: every frame already buffered on
+                # this socket is handled as ONE batch, then the dirtied
+                # logs flush and the writer drains once for the whole
+                # wave — the old per-frame flush+drain was the dominant
+                # fixed cost of the socket tier. The cap keeps one hot
+                # connection from starving its peers on the loop.
+                n = 0
+                while body is not None:
+                    n += 1
+                    if binwire.is_binary(body):
+                        session.handle_binary(body)
+                    else:
+                        session.handle(json.loads(body.decode()))
+                    body = None
+                    if n < 64 and _frame_buffered(reader):
+                        # completes synchronously — the bytes are
+                        # already in the stream buffer
+                        body = await _read_body(reader)
+                counters.inc("net.ingress.frames", n)
+                counters.inc("net.ingress.batches")
+                if n > 1:
+                    counters.inc("net.ingress.coalesced", n - 1)
                 if self._log_flush:
-                    # make this frame's appends visible to the stage
-                    # processes tailing the shared log (dirty-topic-only
-                    # fflush — cheap)
-                    self._flush_logs()
+                    # make this batch's appends visible to the stage
+                    # processes tailing the shared log
+                    self._flush_dirty()
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass  # malformed stream: drop the connection
@@ -881,6 +986,9 @@ class NetworkFrontEnd:
         finally:
             self._sessions.discard(session)
             session.closed()
+            if self._log_flush:
+                # the teardown's leave records must reach the log too
+                self._flush_dirty()
             try:
                 writer.close()
             except Exception:

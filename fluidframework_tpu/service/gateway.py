@@ -37,7 +37,7 @@ import socket as _socket
 from typing import Optional
 
 from ..protocol import binwire
-from .front_end import _encode_frame, _read_body
+from .front_end import _encode_frame, _frame_buffered, _read_body
 
 
 class _GatewaySession:
@@ -401,28 +401,43 @@ class Gateway:
                 body = await _read_body(reader)
                 if body is None:
                     break
-                if binwire.is_binary(body):
-                    # hot path: rewrite submit → fsubmit by prepending the
-                    # sid — op payloads are relayed, never decoded here
-                    if (len(body) >= 2 and body[1] == binwire.FT_SUBMIT
-                            and session.sid is not None
-                            and session.up is not None):
-                        self.upstream_send_raw(binwire.frame(
-                            binwire.submit_to_fsubmit(body, session.sid)),
-                            session.up)
+                # drain-batched serving (same shape as the core's
+                # _handle_conn): relay every frame already buffered on
+                # this socket, then drain the writer once per wave — a
+                # client's coalesced submit burst costs one drain, not
+                # one per frame
+                n = 0
+                while body is not None:
+                    n += 1
+                    if binwire.is_binary(body):
+                        # hot path: rewrite submit → fsubmit by
+                        # prepending the sid — op payloads are relayed,
+                        # never decoded here
+                        if (len(body) >= 2 and body[1] == binwire.FT_SUBMIT
+                                and session.sid is not None
+                                and session.up is not None):
+                            self.upstream_send_raw(binwire.frame(
+                                binwire.submit_to_fsubmit(body,
+                                                          session.sid)),
+                                session.up)
+                        else:
+                            session.push(
+                                {"t": "error",
+                                 "message": "unexpected binary frame"})
                     else:
-                        session.push({"t": "error",
-                                      "message": "unexpected binary frame"})
-                    await writer.drain()
-                    continue
-                frame = json.loads(body.decode())
-                try:
-                    await session.handle(frame)
-                except (RuntimeError, ConnectionError) as e:
-                    # a core error reply (auth refusal, storage failure)
-                    # answers THIS request — it must not kill the socket
-                    session.push({"t": "error", "rid": frame.get("rid"),
-                                  "message": str(e)})
+                        frame = json.loads(body.decode())
+                        try:
+                            await session.handle(frame)
+                        except (RuntimeError, ConnectionError) as e:
+                            # a core error reply (auth refusal, storage
+                            # failure) answers THIS request — it must
+                            # not kill the socket
+                            session.push(
+                                {"t": "error", "rid": frame.get("rid"),
+                                 "message": str(e)})
+                    body = None
+                    if n < 64 and _frame_buffered(reader):
+                        body = await _read_body(reader)
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass

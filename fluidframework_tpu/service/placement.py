@@ -93,20 +93,30 @@ class PlacementDir:
 
     def heartbeat(self, k: int, owner_id: str) -> bool:
         """Refresh the lease mtime; returns False if the lease was lost
-        (taken over) — the caller must stop serving the partition."""
-        cur = self._read(k)
-        if cur is None or cur.get("owner") != owner_id:
-            return False
-        os.utime(self._path(k))
-        return True
+        (taken over) — the caller must stop serving the partition.
+
+        Read-check-utime runs under the SAME flock as try_claim: a
+        stalled ex-owner whose heartbeat resumes mid-takeover would
+        otherwise re-read its own (stale) lease, then utime the file the
+        claimant just replaced — two cores each believing they hold the
+        lease (the two-writer window)."""
+        with self._lock(k):
+            cur = self._read(k)
+            if cur is None or cur.get("owner") != owner_id:
+                return False
+            os.utime(self._path(k))
+            return True
 
     def release(self, k: int, owner_id: str) -> None:
-        cur = self._read(k)
-        if cur is not None and cur.get("owner") == owner_id:
-            try:
-                os.unlink(self._path(k))
-            except OSError:
-                pass
+        # same flock as try_claim/heartbeat: a release racing a takeover
+        # must not unlink the NEW owner's lease after a stale read
+        with self._lock(k):
+            cur = self._read(k)
+            if cur is not None and cur.get("owner") == owner_id:
+                try:
+                    os.unlink(self._path(k))
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------ routers
 

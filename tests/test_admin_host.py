@@ -97,6 +97,30 @@ def test_admin_requires_secret_on_secured_deployment():
         core.wait(timeout=10)
 
 
+def test_mutating_admin_calls_refused_without_secret(capsys):
+    """On a secret-less deployment with NO tenants registered, reads
+    stay open but tenant CRUD is refused: otherwise ANY client could
+    register the first tenant, flip tenancy to enforcing, and lock
+    every other client out (open bootstrap)."""
+    core, port = _spawn(["fluidframework_tpu.service.front_end",
+                         "--port", "0"])
+    try:
+        # read-only admin calls still work without a secret
+        assert _admin(port, "docs") == 0
+        capsys.readouterr()
+        with pytest.raises(RuntimeError):
+            _admin(port, "tenant-add", "acme", "shh")
+        with pytest.raises(RuntimeError):
+            _admin(port, "tenant-rm", "acme")
+        # the refusal really kept tenancy open: unsigned connects work
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", port))
+        c = loader.resolve("t", "stillopen")
+        assert c.client_id
+    finally:
+        core.terminate()
+        core.wait(timeout=10)
+
+
 @pytest.mark.parametrize("app", ["todo", "canvas", "sudoku", "album"])
 def test_example_demo_converges(app):
     out = subprocess.run(

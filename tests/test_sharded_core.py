@@ -49,10 +49,11 @@ def _spawn(args, tmp_path):
     return proc, int(line.rsplit(":", 1)[1])
 
 
-def _core(tmp_path, shard_dir, prefer):
+def _core(tmp_path, shard_dir, prefer, *extra):
     return _spawn(["fluidframework_tpu.service.front_end", "--port", "0",
                    "--shard-dir", str(shard_dir), "--shards", "2",
-                   "--prefer", prefer, "--lease-ttl", TTL], tmp_path)
+                   "--prefer", prefer, "--lease-ttl", TTL, *extra],
+                  tmp_path)
 
 
 def _docs_for_both_partitions(n_each=2):
@@ -253,14 +254,17 @@ def test_admin_tenant_add_secures_partitions_claimed_later(tmp_path):
     shard_dir = tmp_path / "deploy"
     procs = []
     try:
-        core0, p0 = _core(tmp_path, shard_dir, "0")
+        # mutating admin calls require a secret (no open bootstrap)
+        core0, p0 = _core(tmp_path, shard_dir, "0",
+                          "--admin-secret", "adm1n")
         procs.append(core0)
-        core1, p1 = _core(tmp_path, shard_dir, "1")
+        core1, p1 = _core(tmp_path, shard_dir, "1",
+                          "--admin-secret", "adm1n")
         procs.append(core1)
 
         # register the tenant on core1 (which owns only partition 1 now)
-        assert admin.main(["--port", str(p1), "tenant-add",
-                           "acme", "shh"]) == 0
+        assert admin.main(["--port", str(p1), "--admin-secret", "adm1n",
+                           "tenant-add", "acme", "shh"]) == 0
 
         by_part = _docs_for_both_partitions(n_each=1)
         d0 = by_part[0][0]  # partition core1 does NOT own yet

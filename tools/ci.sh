@@ -2,7 +2,7 @@
 # CI gate: static contracts, import health, and a deterministic chaos
 # smoke — everything a commit must survive before the full test run.
 #
-#   tools/ci.sh              # fluidlint + collection check + chaos soak
+#   tools/ci.sh              # fluidlint + collection + net smoke + soak
 #   tools/ci.sh --no-soak    # skip the soak (doc-only changes)
 #
 # The soak runs the seeded fault campaign at a FIXED seed so a CI
@@ -24,6 +24,9 @@ python -m tools.fluidlint
 echo "--- pytest collection check"
 python -m pytest tests/ -q --collect-only -p no:cacheprovider >/dev/null
 echo "collection: ok"
+
+echo "--- socket-tier batching smoke"
+python -m tools.net_smoke
 
 if [ "$run_soak" = 1 ]; then
     echo "--- chaos soak (fixed seed, quick)"

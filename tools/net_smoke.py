@@ -1,0 +1,169 @@
+"""Socket-tier batching smoke: fail CI if the coalescing never engages.
+
+``python -m tools.net_smoke`` (wired into tools/ci.sh) runs an
+in-process NetworkFrontEnd over a durable log and drives the three
+amortization points of the socket tier (see ARCHITECTURE.md
+"Socket-tier batching"):
+
+- a driver client submitting a rapid burst through a forced coalescing
+  window — ``driver.submit.coalesced`` must rise and the burst must
+  ride FEWER frames than ops;
+- a raw socket delivering many frames in one TCP wave — the server's
+  drain-batched read loop must count ``net.ingress.coalesced``;
+- two subscribers on one doc — the encode-once fan-out must count
+  ``net.fanout.cache_hits``;
+- a read-only frame after quiescence — ``net.flush.elided`` must rise,
+  and the submit batches must have counted ``net.flush.performed``.
+
+Exit 1 names every counter that stayed at zero: a refactor that
+silently disengages the batching fails the commit gate, not the next
+bench run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+N_OPS = 200
+BURST_FRAMES = 16
+
+
+def wait_for(pred, timeout: float = 20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+def _frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.protocol.serialization import message_to_dict
+    from fluidframework_tpu.service.durable_log import DurableLog
+    from fluidframework_tpu.service.front_end import NetworkFrontEnd
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    def op(cseq: int, i: int) -> DocumentMessage:
+        return DocumentMessage(
+            client_sequence_number=cseq, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"i": i})
+
+    tmp = tempfile.mkdtemp(prefix="net-smoke-")
+    front = NetworkFrontEnd(
+        LocalServer(log=DurableLog(os.path.join(tmp, "log")))
+    ).start_background()
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front.port)
+    conn1 = factory.create_document_service(
+        "smoke", "doc").connect_to_delta_stream()
+    # force the window on (the adaptive tuner would keep an idle client
+    # inline): the smoke asserts the MECHANISM, not the tuner
+    conn1.coalesce_window = 0.002
+    conn2 = factory.create_document_service(
+        "smoke", "doc").connect_to_delta_stream()
+    seen1: list = []
+    seen2: list = []
+    conn1.on_op = seen1.append
+    conn2.on_op = seen2.append
+
+    for i in range(N_OPS):
+        conn1.submit([op(i + 1, i)])
+
+    def delivered(seen, cid, want):
+        return sum(1 for m in seen if m.client_id == cid) >= want
+
+    if not wait_for(lambda: delivered(seen1, conn1.client_id, N_OPS)
+                    and delivered(seen2, conn1.client_id, N_OPS)):
+        print("net_smoke: FAIL — coalesced burst did not converge "
+              f"({len(seen1)}/{len(seen2)} of {N_OPS})", file=sys.stderr)
+        return 1
+
+    # raw socket: many frames in ONE TCP wave — the drain-batched read
+    # loop must serve them as one batch
+    s = socket.create_connection(("127.0.0.1", front.port), timeout=10)
+    rbuf = b""
+
+    def read_frame() -> dict:
+        nonlocal rbuf
+        while True:
+            if len(rbuf) >= 4:
+                n = int.from_bytes(rbuf[:4], "big")
+                if len(rbuf) >= 4 + n:
+                    body, rbuf = rbuf[4:4 + n], rbuf[4 + n:]
+                    return json.loads(body.decode())
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("smoke socket closed")
+            rbuf += chunk
+
+    s.sendall(_frame({"t": "connect", "tenant": "smoke", "doc": "doc",
+                      "rid": 1, "bin": 0}))
+    reply = read_frame()
+    while reply.get("rid") != 1:
+        reply = read_frame()
+    raw_cid = reply["clientId"]
+    s.sendall(b"".join(
+        _frame({"t": "submit", "ops": [message_to_dict(op(i + 1, i))]})
+        for i in range(BURST_FRAMES)))
+    if not wait_for(lambda: delivered(seen2, raw_cid, BURST_FRAMES)):
+        print("net_smoke: FAIL — raw burst did not converge",
+              file=sys.stderr)
+        return 1
+    # quiescent now: a lone read-only frame must ELIDE the flush
+    s.sendall(_frame({"t": "ping"}))
+    reply = read_frame()
+    while reply.get("t") != "pong":
+        reply = read_frame()
+
+    drv = factory.counters.snapshot()
+    srv = front.counters.snapshot()
+    checks = {
+        "driver.submit.coalesced": drv.get("driver.submit.coalesced", 0),
+        "net.ingress.coalesced": srv.get("net.ingress.coalesced", 0),
+        "net.fanout.cache_hits": srv.get("net.fanout.cache_hits", 0),
+        "net.flush.performed": srv.get("net.flush.performed", 0),
+        "net.flush.elided": srv.get("net.flush.elided", 0),
+    }
+    frames = drv.get("driver.submit.frames", 0)
+    ops = drv.get("driver.submit.ops", 0)
+
+    conn1.close()
+    conn2.close()
+    s.close()
+    front.stop()
+
+    print(json.dumps({"checks": checks,
+                      "driver.submit.frames": frames,
+                      "driver.submit.ops": ops}, indent=2))
+    dead = sorted(k for k, v in checks.items() if v == 0)
+    if dead:
+        print(f"net_smoke: FAIL — counters stayed at zero under load: "
+              f"{dead}", file=sys.stderr)
+        return 1
+    if frames >= ops:
+        print(f"net_smoke: FAIL — coalescing never reduced frame count "
+              f"(frames={frames}, ops={ops})", file=sys.stderr)
+        return 1
+    print("net_smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
