@@ -16,8 +16,10 @@ number, not a dispatch microbenchmark):
 - ``net_p99_ack_ms`` / ``net_p50_ack_ms``: op-ack latency through real
   TCP sockets (submit → own op broadcast back), north star p99 < 50 ms.
 
-vs_baseline is the headline value against the 50k north star
-(BASELINE.json — the reference repo publishes no numbers of its own).
+vs_north_star_50k is the headline value against the 50k north star
+(BASELINE.json — the reference repo publishes no numbers of its own);
+vs_scalar_deli_x is the same value against the single-process scalar
+``_ticket`` lane, the per-op reference the array/columnar path amortizes.
 """
 
 from __future__ import annotations
@@ -81,6 +83,54 @@ def bench_kernel() -> tuple:
         assert not np.asarray(cur.overflow).any(), "overflowed docs skip work"
         results.append(D * K * NB / dt)
     return results[0], results[1]
+
+
+def bench_scalar_deli() -> float:
+    """The scalar ``_ticket`` lane in isolation: one process, one doc,
+    per-op RawMessages through deli.handler — no boxcars, no arrays.
+
+    This is the per-op-object reference the boxcar/array/columnar path
+    amortizes; ``vs_scalar_deli_x`` publishes how much of the headline
+    comes from batching vs from the kernel. Median of 3 trials."""
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.service.core import QueuedMessage
+    from fluidframework_tpu.service.deli import DeliLambda, RawMessage
+
+    def chanop(i: int) -> dict:
+        return {"kind": "chanop", "address": "default",
+                "contents": {"address": "text",
+                             "contents": {"type": 0, "pos": i,
+                                          "text": "abcdefgh"}}}
+
+    n = 100_000
+    rates = []
+    for trial in range(3):
+        deli = DeliLambda("bench", "scalar",
+                          send_sequenced=lambda m: None,
+                          send_nack=lambda c, nk: None,
+                          clock=lambda: 1000.0)
+        deli.handler(QueuedMessage(1, "raw", 0, RawMessage(
+            "bench", "scalar", None,
+            DocumentMessage(-1, -1, MessageType.CLIENT_JOIN,
+                            {"clientId": "c1"}), 1000.0)))
+        records = [
+            QueuedMessage(i + 2, "raw", 0, RawMessage(
+                "bench", "scalar", "c1",
+                DocumentMessage(i + 1, 0, MessageType.OPERATION,
+                                chanop(i)), 1000.0))
+            for i in range(n)
+        ]
+        handler = deli.handler
+        t0 = time.perf_counter()
+        for rec in records:
+            handler(rec)
+        dt = time.perf_counter() - t0
+        assert deli.sequence_number == n + 1  # join + every op ticketed
+        rates.append(n / dt)
+    return sorted(rates)[1]
 
 
 def bench_service() -> dict:
@@ -426,6 +476,24 @@ def bench_network() -> dict:
                     break
             if cfg4["p99_ack_ms"] < 50.0 and cfg4["late_s"] == 0:
                 break
+
+        # ---- NORTH-STAR geometry: 10,000 DOCS (1 client each, 10k
+        # sockets, 4 gateways). The north star names 10k docs; cfg4's
+        # 1k-docs × 10-clients row exercises fan-out, this row
+        # exercises doc-table scale (10× the orderers, no fan-out
+        # amplification). Same taint/retry machinery as cfg4: a late
+        # worker (late_s > 0) measured the join storm, so each rate
+        # retries once at a wider start margin before stepping down. ----
+        n10k = None
+        for rate in (0.15, 0.125, 0.1, 0.075, 0.05, 0.035):
+            for attempt, margin in (("", 40.0), ("b", 110.0)):
+                n10k = run_workers(gw_ports, 4, 2500, 1, rate, 8, 3,
+                                   f"t10k{rate}{attempt}",
+                                   start_margin=margin, timeout=420.0)
+                if n10k["p99_ack_ms"] < 50.0 and n10k["late_s"] == 0:
+                    break
+            if n10k["p99_ack_ms"] < 50.0 and n10k["late_s"] == 0:
+                break
         # the single-core tier is torn down — and WAITED on — before the
         # sharded run: 4 gateways dropping 10k sockets spend seconds in
         # teardown, and that CPU must not bleed into the sharded trial
@@ -446,6 +514,7 @@ def bench_network() -> dict:
             "knee": best,
             "direct": direct,
             "cfg4": cfg4,
+            "net_10k_docs": n10k,
             "sharded": sharded,
             "batching": batching,
         }
@@ -515,6 +584,7 @@ def main() -> None:
     # with a TPU tunnel already saturated by the kernel/service benches
     net = bench_network()
     kernel_ops, kernel_xla_ops = bench_kernel()
+    scalar_deli = bench_scalar_deli()
     service = bench_service()
     print(
         json.dumps(
@@ -524,7 +594,14 @@ def main() -> None:
                 "unit": "ops/s",
                 # against the 50k NORTH STAR (BASELINE.json: the
                 # reference repo publishes no numbers of its own)
-                "vs_baseline": round(service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
+                "vs_north_star_50k": round(
+                    service["ops_per_sec"] / NORTH_STAR_OPS_PER_SEC, 3),
+                # the scalar _ticket lane (one process, per-op message
+                # objects, no boxcars) and the headline's speedup over
+                # it: what the boxcar/array/columnar batching buys
+                "scalar_deli_ops_per_sec": round(scalar_deli, 1),
+                "vs_scalar_deli_x": round(
+                    service["ops_per_sec"] / scalar_deli, 2),
                 # the same pipeline fed per-op message objects instead
                 # of the array-lane boxcars (deli-tpu marshal)
                 "ops_per_sec_dict_lane": service.get("ops_per_sec_dict_lane"),
@@ -559,6 +636,9 @@ def main() -> None:
                 "net_ops_per_sec_1k_docs": net["cfg4"]["ops_per_sec"],
                 "net_p50_ack_ms_1k_docs": net["cfg4"]["p50_ack_ms"],
                 "net_p99_ack_ms_1k_docs": net["cfg4"]["p99_ack_ms"],
+                # north-star geometry: 10,000 docs × 1 client (10k
+                # sockets, doc-table scale without fan-out amplification)
+                "net_10k_docs": net["net_10k_docs"],
                 # 2-core SHARDED ordering core at the knee geometry
                 # (VERDICT r4 #4: the sequencer scales out; target
                 # >= 1.5x the 1-core knee)

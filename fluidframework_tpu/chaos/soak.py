@@ -678,6 +678,15 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
                 "phase B never drove a multi-op boxcar through the "
                 f"fault plane (frames={frames}, ops={ops}) — the "
                 "coalesced submit path went unexercised")
+        if not snap.get("driver.submit.columnar", 0):
+            # columnar frames keep kind="submit" on the net.send seam,
+            # so the drop/dup/delay/truncate rules above faulted them;
+            # a zero counter means the fast path silently disengaged
+            # and the soak stopped covering it
+            raise InvariantViolation(
+                "phase B never drove a COLUMNAR boxcar through the "
+                "fault plane — the columnar ingress path went "
+                "unexercised under faults")
         for c in clients:
             c.conn.close()
     finally:

@@ -439,13 +439,22 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def _send_ops(self, ops: list) -> None:
         for i in range(0, len(ops), _MAX_BOXCAR_OPS):
             chunk = ops[i:i + _MAX_BOXCAR_OPS]
-            try:
-                body = binwire.encode_submit(chunk)
-            except Exception:
-                # a boxcar binwire cannot pack (>u16 ops, int outside
-                # the fixed-field range) still goes through: the
-                # server accepts both frame kinds on any connection
-                body = None
+            # columnar first: a canonical chanop boxcar rides the
+            # fixed-stride column frame the server admits without
+            # materializing per-op objects (kind stays "submit" so the
+            # chaos net.send rules fault both frame families alike)
+            columnar = False
+            body = binwire.encode_submit_columns(chunk)
+            if body is not None:
+                columnar = True
+            else:
+                try:
+                    body = binwire.encode_submit(chunk)
+                except Exception:
+                    # a boxcar binwire cannot pack (>u16 ops, int outside
+                    # the fixed-field range) still goes through: the
+                    # server accepts both frame kinds on any connection
+                    body = None
             with self._t.lock:
                 if body is not None:
                     self._t.send_body(body, kind="submit")
@@ -455,6 +464,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                          "ops": [message_to_dict(m) for m in chunk]})
             self.counters.inc("driver.submit.frames")
             self.counters.inc("driver.submit.ops", len(chunk))
+            if columnar:
+                self.counters.inc("driver.submit.columnar")
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})

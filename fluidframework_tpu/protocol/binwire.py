@@ -19,9 +19,10 @@ Layout (all integers big-endian):
     body   := MAGIC ftype hdr(ftype) batch
     MAGIC  := 0x01
     ftype  := 1 submit | 2 ops | 3 fsubmit | 4 fops
-    hdr    := ""                       (submit, ops)
-            | u32 sid                  (fsubmit)
-            | u16 len + utf8 topic     (fops)
+            | 5 cols_submit | 6 cols_fsubmit | 7 cols_ops | 8 cols_fops
+    hdr    := ""                       (submit, ops, cols_submit, cols_ops)
+            | u32 sid                  (fsubmit, cols_fsubmit)
+            | u16 len + utf8 topic     (fops, cols_fops)
     batch  := pool recs
     pool   := u16 n; n × (u16 len + utf8)     -- interned strings
     recs   := u16 n; n × rec
@@ -65,6 +66,8 @@ import json
 import struct
 from typing import Optional
 
+import numpy as np
+
 from .messages import (
     DocumentMessage,
     MessageType,
@@ -77,6 +80,10 @@ FT_SUBMIT = 1
 FT_OPS = 2
 FT_FSUBMIT = 3
 FT_FOPS = 4
+FT_COLS_SUBMIT = 5
+FT_COLS_FSUBMIT = 6
+FT_COLS_OPS = 7
+FT_COLS_FOPS = 8
 
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
@@ -352,6 +359,8 @@ def decode_ops(body: bytes) -> tuple[Optional[str],
                                      list[SequencedDocumentMessage]]:
     """Decode an ops/fops body → (topic or None, msgs)."""
     ftype = body[1]
+    if ftype == FT_COLS_OPS or ftype == FT_COLS_FOPS:
+        return decode_cols_ops(body)
     if ftype == FT_FOPS:
         (tl,) = _U16.unpack_from(body, 2)
         topic = body[4:4 + tl].decode()
@@ -448,8 +457,21 @@ def scan_ops(body: bytes):
     generator emits ASCII-only text), -span for a remove, 0 otherwise
     (annotate/generic). ``deli_ts`` is the last deli/sequence trace hop
     timestamp when the record carries one.
+
+    Columnar batches (FT_COLS_OPS/FOPS) carry no per-record traces: the
+    stamp timestamp IS the deli ticket time, so every record yields it
+    as ``deli_ts`` — the hop split stays honest without trace bytes.
     """
     ftype = body[1]
+    if ftype == FT_COLS_OPS or ftype == FT_COLS_FOPS:
+        _, cid, base_seq, ts, sc, _msns = _read_cols_stamp(body)
+        kind = sc.kind
+        delta = np.where(
+            kind == 0, np.diff(sc.text_off),
+            np.where(kind == 1, sc.a - sc.b, 0)).tolist()
+        for i, cseq in enumerate(sc.cseq.tolist()):
+            yield cid, base_seq + i, cseq, ts, delta[i]
+        return
     if ftype == FT_FOPS:
         (tl,) = _U16.unpack_from(body, 2)
         off = 4 + tl
@@ -499,20 +521,368 @@ def scan_ops(body: bytes):
                seq, cseq, deli_ts, delta)
 
 
+# ------------------------------------------------------------- columnar
+# Fixed-stride column frames: the zero-materialization ingress path.
+#
+# The rec-oriented frames above are variable-length per record, so the
+# server must walk them op by op. The columnar family carries the SAME
+# boxcar as packed SoA columns that ``np.frombuffer`` views in O(1),
+# feeding deli's array lane without ever materializing per-op objects.
+# A submit boxcar is columnar-eligible when every op is a canonical
+# same-channel chanop (insert/remove/annotate, no metadata/traces) —
+# exactly the shape the merge-tree runtime emits; anything else rides
+# the rec frames unchanged.
+#
+# Layout (the column section is LITTLE-endian — a deliberate deviation
+# from the big-endian rec frames so the columns are numpy-native views
+# on LE hosts; outer headers stay big-endian so the gateway's 6-byte
+# fsubmit prepend and u16-topic fops strip work byte-identically across
+# both families):
+#
+#     body := MAGIC ftype hdr(ftype) section
+#     ftype := 5 cols_submit | 6 cols_fsubmit | 7 cols_ops | 8 cols_fops
+#     hdr   := ""                    (cols_submit, cols_ops)
+#            | u32 sid               (cols_fsubmit, big-endian)
+#            | u16 len + utf8 topic  (cols_fops, big-endian)
+#     section(submit) := cols
+#     section(ops)    := stamp cols n×i64 msns
+#     stamp := u16 cid_len + utf8 client_id, i64 base_seq, f64 timestamp
+#     cols  := u16 n, u16 ds_len + utf8, u16 ch_len + utf8,
+#              n×u8 kind, n×i32 a, n×i32 b, n×i32 cseq, n×i32 rseq,
+#              (n+1)×i32 text_off, u32 tlen + utf8 text,
+#              u32 plen + utf8 props-JSON (plen 0 = no annotate props)
+#
+# ``a``/``b`` are pos/0 for inserts, start/end for removes/annotates;
+# ``text_off`` are cumulative CHARACTER offsets into ``text`` (insert i
+# owns text[text_off[i]:text_off[i+1]]). Record i's sequence number in a
+# stamped frame is base_seq + i; the stamp timestamp is deli's ticket
+# time for the whole batch (replaces per-record trace hops).
+#
+# The load-bearing property: deli stamping is a byte SPLICE — the ops
+# frame embeds the submit frame's ``cols`` bytes VERBATIM between the
+# stamp and the appended msns, so the broadcast fan-out re-encodes
+# nothing (see stamp_cols_ops and front_end._push_abatch).
+
+
+class SubmitColumns:
+    """Decoded column view of a columnar submit boxcar.
+
+    The array fields are zero-copy ``np.frombuffer`` views into the
+    received frame; ``cols`` is the raw column section (the splice
+    input for :func:`stamp_cols_ops`).
+    """
+
+    __slots__ = ("ds_id", "channel_id", "kind", "a", "b", "cseq", "rseq",
+                 "text", "text_off", "props", "cols")
+
+    def __init__(self, ds_id, channel_id, kind, a, b, cseq, rseq,
+                 text, text_off, props, cols):
+        self.ds_id = ds_id
+        self.channel_id = channel_id
+        self.kind = kind
+        self.a = a
+        self.b = b
+        self.cseq = cseq
+        self.rseq = rseq
+        self.text = text
+        self.text_off = text_off
+        self.props = props
+        self.cols = cols
+
+    @property
+    def n(self) -> int:
+        return len(self.kind)
+
+
+def _i32_ok(*vals) -> bool:
+    for v in vals:
+        if type(v) is not int or v < 0 or v > 0x7FFFFFFF:
+            return False
+    return True
+
+
+def encode_cols(ds_id: str, channel_id: str, kind, a, b, cseq, rseq,
+                text: str, text_off, props) -> bytes:
+    """Pack column arrays into the shared ``cols`` section."""
+    n = len(kind)
+    if not 0 < n <= 0xFFFF:
+        raise ValueError(f"columnar boxcar size {n} out of range")
+    dsb = ds_id.encode()
+    chb = channel_id.encode()
+    if len(dsb) > 0xFFFF or len(chb) > 0xFFFF:
+        raise ValueError("address too long for columnar frame")
+    tb = text.encode()
+    pb = (b"" if props is None
+          else json.dumps(props, separators=(",", ":")).encode())
+    return b"".join((
+        n.to_bytes(2, "little"),
+        len(dsb).to_bytes(2, "little"), dsb,
+        len(chb).to_bytes(2, "little"), chb,
+        np.ascontiguousarray(kind, np.int8).tobytes(),
+        np.ascontiguousarray(a, "<i4").tobytes(),
+        np.ascontiguousarray(b, "<i4").tobytes(),
+        np.ascontiguousarray(cseq, "<i4").tobytes(),
+        np.ascontiguousarray(rseq, "<i4").tobytes(),
+        np.ascontiguousarray(text_off, "<i4").tobytes(),
+        len(tb).to_bytes(4, "little"), tb,
+        len(pb).to_bytes(4, "little"), pb,
+    ))
+
+
+def _read_cols(body: bytes, off: int) -> tuple[SubmitColumns, int]:
+    start = off
+    n = int.from_bytes(body[off:off + 2], "little")
+    off += 2
+    if n == 0:
+        raise ValueError("empty columnar boxcar")
+    ln = int.from_bytes(body[off:off + 2], "little")
+    off += 2
+    ds = body[off:off + ln].decode()
+    off += ln
+    ln = int.from_bytes(body[off:off + 2], "little")
+    off += 2
+    ch = body[off:off + ln].decode()
+    off += ln
+    kind = np.frombuffer(body, np.int8, n, off)
+    off += n
+    a = np.frombuffer(body, "<i4", n, off)
+    off += 4 * n
+    b = np.frombuffer(body, "<i4", n, off)
+    off += 4 * n
+    cseq = np.frombuffer(body, "<i4", n, off)
+    off += 4 * n
+    rseq = np.frombuffer(body, "<i4", n, off)
+    off += 4 * n
+    text_off = np.frombuffer(body, "<i4", n + 1, off)
+    off += 4 * (n + 1)
+    tlen = int.from_bytes(body[off:off + 4], "little")
+    off += 4
+    text = body[off:off + tlen].decode()
+    off += tlen
+    plen = int.from_bytes(body[off:off + 4], "little")
+    off += 4
+    props = json.loads(body[off:off + plen]) if plen else None
+    off += plen
+    if off > len(body):
+        raise ValueError("truncated columnar frame")
+    return SubmitColumns(ds, ch, kind, a, b, cseq, rseq, text, text_off,
+                         props, body[start:off]), off
+
+
+def encode_submit_columns(ops: list[DocumentMessage], *,
+                          sid: Optional[int] = None) -> Optional[bytes]:
+    """Encode a submit boxcar as a columnar frame, or None if ineligible.
+
+    Eligibility mirrors :func:`_encode_payload`'s fast-kind strictness
+    (canonical chanop dicts, i32-range positions, no metadata) plus the
+    columnar constraints: one (ds, channel) per boxcar and no trace
+    hops (the stamp timestamp replaces them). Callers fall back to
+    :func:`encode_submit` on None — the rec path round-trips anything.
+    """
+    n = len(ops)
+    if not 0 < n <= 0xFFFF:
+        return None
+    ds_id = ch_id = None
+    kinds: list[int] = []
+    av: list[int] = []
+    bv: list[int] = []
+    cs: list[int] = []
+    rs: list[int] = []
+    toff: list[int] = [0]
+    texts: list[str] = []
+    prs: list = []
+    for m in ops:
+        if m.type is not _OP_TYPE or m.metadata is not None or m.traces:
+            return None
+        parts = _chanop_parts(m.contents)
+        if parts is None:
+            return None
+        ds, ch, op = parts
+        if ds_id is None:
+            ds_id, ch_id = ds, ch
+        elif ds != ds_id or ch != ch_id:
+            return None
+        t = op.get("type")
+        pr = None
+        if t == 0 and len(op) == 3:
+            pos, text = op.get("pos"), op.get("text")
+            if type(text) is not str or not _i32_ok(pos):
+                return None
+            kinds.append(0)
+            av.append(pos)
+            bv.append(0)
+            texts.append(text)
+            toff.append(toff[-1] + len(text))
+        elif t == 1 and len(op) == 3:
+            start, end = op.get("start"), op.get("end")
+            if not _i32_ok(start, end):
+                return None
+            kinds.append(1)
+            av.append(start)
+            bv.append(end)
+            toff.append(toff[-1])
+        elif t == 2 and len(op) == 4 and type(op.get("props")) is dict:
+            start, end = op.get("start"), op.get("end")
+            if not _i32_ok(start, end):
+                return None
+            kinds.append(2)
+            av.append(start)
+            bv.append(end)
+            toff.append(toff[-1])
+            pr = op["props"]
+        else:
+            return None
+        prs.append(pr)
+        cs.append(m.client_sequence_number)
+        rs.append(m.reference_sequence_number)
+    props = prs if any(p is not None for p in prs) else None
+    try:
+        cols = encode_cols(ds_id, ch_id, kinds, av, bv, cs, rs,
+                           "".join(texts), toff, props)
+    except (ValueError, OverflowError, TypeError):
+        return None
+    hdr = (bytes((MAGIC, FT_COLS_SUBMIT)) if sid is None
+           else _FSUB_HDR.pack(MAGIC, FT_COLS_FSUBMIT, sid))
+    return hdr + cols
+
+
+def decode_submit_columns(body: bytes) -> tuple[Optional[int],
+                                                SubmitColumns]:
+    """Decode a cols_submit/cols_fsubmit body → (sid or None, columns)."""
+    ftype = body[1]
+    if ftype == FT_COLS_FSUBMIT:
+        (sid,) = _U32.unpack_from(body, 2)
+        off = _FSUB_HDR.size
+    elif ftype == FT_COLS_SUBMIT:
+        sid, off = None, 2
+    else:
+        raise ValueError(f"not a columnar submit frame (ftype {ftype})")
+    sc, _ = _read_cols(body, off)
+    return sid, sc
+
+
+def _cols_contents(sc: SubmitColumns, kind, a, b, toff, i: int) -> dict:
+    k = kind[i]
+    if k == 0:
+        op = {"type": 0, "pos": a[i],
+              "text": sc.text[toff[i]:toff[i + 1]]}
+    elif k == 1:
+        op = {"type": 1, "start": a[i], "end": b[i]}
+    elif k == 2:
+        op = {"type": 2, "start": a[i], "end": b[i],
+              "props": sc.props[i] if sc.props else {}}
+    else:
+        raise ValueError(f"unknown columnar op kind {k}")
+    return {"kind": "chanop", "address": sc.ds_id,
+            "contents": {"address": sc.channel_id, "contents": op}}
+
+
+def cols_to_ops(sc: SubmitColumns) -> list[DocumentMessage]:
+    """Materialize per-op DocumentMessages (scalar-fallback path)."""
+    kind = sc.kind.tolist() if hasattr(sc.kind, "tolist") else sc.kind
+    a = sc.a.tolist()
+    b = sc.b.tolist()
+    cs = sc.cseq.tolist()
+    rs = sc.rseq.tolist()
+    toff = sc.text_off.tolist()
+    return [DocumentMessage(
+        client_sequence_number=cs[i], reference_sequence_number=rs[i],
+        type=_OP_TYPE, contents=_cols_contents(sc, kind, a, b, toff, i))
+        for i in range(len(kind))]
+
+
+def stamp_cols_ops(cols: bytes, client_id: str, base_seq: int, msns,
+                   timestamp: float, *, topic: Optional[str] = None
+                   ) -> bytes:
+    """Build a cols_ops/cols_fops body by SPLICING the submit's columns.
+
+    ``cols`` is the column section exactly as received (SubmitColumns.
+    cols); only the stamp header and the msn tail are packed fresh —
+    this is deli's sequence/msn stamping as a vectorized byte splice.
+    """
+    cid = client_id.encode()
+    if topic is None:
+        hdr = bytes((MAGIC, FT_COLS_OPS))
+    else:
+        tb = topic.encode()
+        hdr = bytes((MAGIC, FT_COLS_FOPS)) + _U16.pack(len(tb)) + tb
+    return b"".join((
+        hdr,
+        len(cid).to_bytes(2, "little"), cid,
+        int(base_seq).to_bytes(8, "little", signed=True),
+        np.array([timestamp], "<f8").tobytes(),
+        cols,
+        np.ascontiguousarray(msns, "<i8").tobytes(),
+    ))
+
+
+def _read_cols_stamp(body: bytes):
+    """Parse a stamped columnar body → (topic, cid, base_seq, ts, sc,
+    msns)."""
+    ftype = body[1]
+    if ftype == FT_COLS_FOPS:
+        (tl,) = _U16.unpack_from(body, 2)
+        topic = body[4:4 + tl].decode()
+        off = 4 + tl
+    elif ftype == FT_COLS_OPS:
+        topic, off = None, 2
+    else:
+        raise ValueError(f"not a columnar ops frame (ftype {ftype})")
+    cl = int.from_bytes(body[off:off + 2], "little")
+    off += 2
+    cid = body[off:off + cl].decode()
+    off += cl
+    base_seq = int.from_bytes(body[off:off + 8], "little", signed=True)
+    off += 8
+    ts = float(np.frombuffer(body, "<f8", 1, off)[0])
+    off += 8
+    sc, off = _read_cols(body, off)
+    msns = np.frombuffer(body, "<i8", sc.n, off)
+    return topic, cid, base_seq, ts, sc, msns
+
+
+def decode_cols_ops(body: bytes) -> tuple[Optional[str],
+                                          list[SequencedDocumentMessage]]:
+    """Materialize a stamped columnar batch as sequenced messages.
+
+    The compatibility path for rec-frame consumers (driver read loop,
+    legacy JSON fan-out): hot subscribers consume the frame bytes or
+    the SequencedArrayBatch directly and never call this.
+    """
+    topic, cid, base_seq, ts, sc, msns = _read_cols_stamp(body)
+    kind = sc.kind.tolist()
+    a = sc.a.tolist()
+    b = sc.b.tolist()
+    cs = sc.cseq.tolist()
+    rs = sc.rseq.tolist()
+    toff = sc.text_off.tolist()
+    mlist = msns.tolist()
+    msgs = [SequencedDocumentMessage(
+        client_id=cid, sequence_number=base_seq + i,
+        minimum_sequence_number=mlist[i],
+        client_sequence_number=cs[i], reference_sequence_number=rs[i],
+        type=_OP_TYPE, contents=_cols_contents(sc, kind, a, b, toff, i),
+        timestamp=ts)
+        for i in range(len(kind))]
+    return topic, msgs
+
+
 # --------------------------------------------------- gateway byte rewrites
 # The relay operations gateway.py performs WITHOUT decoding op payloads.
 
 
 def submit_to_fsubmit(body: bytes, sid: int) -> bytes:
     """Rewrite a client ``submit`` body into an upstream ``fsubmit``."""
-    return _FSUB_HDR.pack(MAGIC, FT_FSUBMIT, sid) + body[2:]
+    ft = FT_COLS_FSUBMIT if body[1] == FT_COLS_SUBMIT else FT_FSUBMIT
+    return _FSUB_HDR.pack(MAGIC, ft, sid) + body[2:]
 
 
 def fops_strip_topic(body: bytes) -> tuple[str, bytes]:
     """Split an ``fops`` body → (topic, client-facing ``ops`` body)."""
+    ft = FT_COLS_OPS if body[1] == FT_COLS_FOPS else FT_OPS
     (tl,) = _U16.unpack_from(body, 2)
     topic = body[4:4 + tl].decode()
-    return topic, bytes((MAGIC, FT_OPS)) + body[4 + tl:]
+    return topic, bytes((MAGIC, ft)) + body[4 + tl:]
 
 
 def is_binary(body: bytes) -> bool:

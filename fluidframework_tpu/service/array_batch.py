@@ -63,6 +63,12 @@ class ArrayBoxcar:
     text_off: np.ndarray  # int32 [n+1] offsets into text (non-inserts 0-len)
     props: Optional[list] = None  # per-op props dict or None (annotates)
     timestamp: float = 0.0
+    # raw binwire column section the boxcar arrived as (columnar ingress):
+    # broadcast stamping splices these bytes verbatim instead of
+    # re-encoding. Transport cache only — deliberately OUTSIDE the
+    # durable codecs below (a replayed boxcar re-encodes on demand).
+    wire_cols: Optional[bytes] = field(default=None, repr=False,
+                                       compare=False)
 
     @property
     def n(self) -> int:

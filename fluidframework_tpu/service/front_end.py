@@ -60,6 +60,7 @@ from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType
 from ..protocol.serialization import message_from_dict, message_to_dict
 from ..utils.telemetry import Counters
+from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
@@ -69,6 +70,21 @@ DEFAULT_MAX_MESSAGE_SIZE = 16 * 1024  # per-op cap, nacked (ref :96)
 def _encode_frame(obj: dict) -> bytes:
     body = json.dumps(obj, separators=(",", ":")).encode()
     return len(body).to_bytes(4, "big") + body
+
+
+def _stamp_abatch(batch, topic=None) -> bytes:
+    """Sequenced columnar broadcast body: splice deli's stamp onto the
+    column bytes the submit frame carried (zero re-encode); a boxcar
+    that arrived without them (in-proc submit_array, durable replay)
+    re-packs its columns once here."""
+    box = batch.boxcar
+    cols = box.wire_cols
+    if cols is None:
+        cols = binwire.encode_cols(
+            box.ds_id, box.channel_id, box.kind, box.a, box.b,
+            box.cseq, box.rseq, box.text, box.text_off, box.props)
+    return binwire.stamp_cols_ops(cols, box.client_id, batch.base_seq,
+                                  batch.msns, batch.timestamp, topic=topic)
 
 
 async def _read_body(reader: asyncio.StreamReader) -> Optional[bytes]:
@@ -215,6 +231,46 @@ class _ClientSession:
             front.counters.inc("net.fanout.cache_hits")
         self.push_raw(raw)
 
+    def _push_abatch(self, batch) -> None:
+        """Columnar twin of ``_push_op_batch`` for SequencedArrayBatch.
+
+        The binary broadcast frame is the submit frame's column bytes
+        with deli's stamp spliced on (``stamp_cols_ops``) — no per-op
+        encode at all; the JSON slot materializes lazily only if a
+        legacy subscriber shares the doc. Same one-entry cache, so the
+        fan-out costs one splice total."""
+        conn = self.conn
+        front = self.front
+        key = (conn.tenant_id, conn.document_id, batch.base_seq, batch.n)
+        cached_key, slots = front._batch_cache
+        if cached_key != key:
+            slots = [None, None]  # [binwire raw | False, JSON raw]
+            front._batch_cache = (key, slots)
+        if self.binary:
+            raw = slots[0]
+            if raw is None:
+                try:
+                    raw = binwire.frame(_stamp_abatch(batch))
+                except Exception:
+                    raw = False
+                slots[0] = raw
+                front.counters.inc("net.fanout.encodes")
+            else:
+                front.counters.inc("net.fanout.cache_hits")
+            if raw is not False:
+                self.push_raw(raw)
+                return
+        raw = slots[1]
+        if raw is None:
+            raw = _encode_frame(
+                {"t": "ops",
+                 "msgs": [message_to_dict(m) for m in batch.messages()]})
+            slots[1] = raw
+            front.counters.inc("net.fanout.encodes")
+        else:
+            front.counters.inc("net.fanout.cache_hits")
+        self.push_raw(raw)
+
     def push_raw(self, raw: bytes) -> None:
         try:
             if self.writer.is_closing():
@@ -246,6 +302,7 @@ class _ClientSession:
                 # the per-op frame overhead (json + syscall each) was the
                 # front end's dominant cost
                 conn.on_ops = self._push_op_batch
+                conn.on_abatch = self._push_abatch
                 conn.on_nack = lambda n: self.push(
                     "nack", {"nack": message_to_dict(n)})
                 conn.on_signal = lambda s: self.push(
@@ -339,6 +396,9 @@ class _ClientSession:
                     finally:
                         self.front._splice_ctx = None
                     self.front._dirty_servers.add(conn.server)
+            elif (ftype == binwire.FT_COLS_SUBMIT
+                  or ftype == binwire.FT_COLS_FSUBMIT):
+                self._submit_columns(body)
             else:
                 raise ValueError(f"unexpected binary frame type {ftype}")
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
@@ -379,6 +439,51 @@ class _ClientSession:
                 kept.append(op)
         return kept
 
+    def _submit_columns(self, body: bytes) -> None:
+        """Columnar ingress: hand a submit boxcar to deli's array lane
+        with the op payload still in packed columns.
+
+        Bulk admission happens in two vectorized stages, each with a
+        per-op scalar fallback so nack semantics are byte-identical to
+        the rec path: the front end verifies writability and the
+        boxcar-level size bound here (failure → materialize +
+        ``_filter_oversized`` + ``conn.submit``, same as a rec frame);
+        deli's ``_ticket_array_boxcar`` verifies join/clientSeq/refSeq
+        on the columns and falls back to the scalar ``_ticket`` loop
+        itself when they don't hold. The admitted path never builds a
+        per-op object: the columns become an ArrayBoxcar (frombuffer
+        views) carrying the frame's column bytes for splice-stamped
+        fan-out (``_push_abatch``)."""
+        front = self.front
+        sid, sc = binwire.decode_submit_columns(body)
+        if sid is None:
+            conn = self.conn
+            if conn is None:
+                raise RuntimeError("submit before connect")
+        else:
+            conn = self._fsessions[sid]
+        limit = front.max_message_size
+        if (getattr(conn, "can_write", True)
+                and 6 * len(body) + 512 <= limit):
+            box = ArrayBoxcar(
+                tenant_id="", document_id="", client_id="",
+                ds_id=sc.ds_id, channel_id=sc.channel_id,
+                kind=sc.kind, a=sc.a, b=sc.b, cseq=sc.cseq, rseq=sc.rseq,
+                text=sc.text, text_off=sc.text_off, props=sc.props,
+                wire_cols=sc.cols)
+            conn.submit_array(box)
+            front.counters.inc("net.ingress.columnar")
+        else:
+            # read-only connections nack PER OP through the scalar door
+            # (the array door nacks once per boxcar); oversize frames
+            # need the per-op JSON measure anyway
+            ops = self._filter_oversized(binwire.cols_to_ops(sc),
+                                         None, sid)
+            if ops:
+                conn.submit(ops)
+            front.counters.inc("net.ingress.fallback")
+        front._dirty_servers.add(conn.server)
+
     def _handle_gateway(self, t: str, frame: dict, rid) -> None:
         """Backbone mux for a gateway connection (see module docstring).
 
@@ -411,7 +516,32 @@ class _ClientSession:
                 if self._fbinary:
                     def on_batch(batch, topic=topic):
                         # one binwire encode per batch, shared across
-                        # gateways via the front-end fops cache
+                        # gateways via the front-end fops cache; a
+                        # SequencedArrayBatch (columnar array lane)
+                        # splice-stamps its column bytes instead
+                        if type(batch) is not list:
+                            key = (topic, batch.base_seq, batch.n)
+                            ck, raw = self.front._fops_cache
+                            if ck != key:
+                                try:
+                                    raw = binwire.frame(
+                                        _stamp_abatch(batch, topic=topic))
+                                except Exception:
+                                    raw = None  # unpackable: JSON
+                                self.front._fops_cache = (key, raw)
+                                self.front.counters.inc(
+                                    "net.fanout.encodes")
+                            else:
+                                self.front.counters.inc(
+                                    "net.fanout.cache_hits")
+                            if raw is not None:
+                                self.push_raw(raw)
+                            else:
+                                self.push("fops", {
+                                    "topic": topic,
+                                    "msgs": [message_to_dict(m)
+                                             for m in batch.messages()]})
+                            return
                         key = (topic, batch[0].sequence_number, len(batch))
                         ck, raw = self.front._fops_cache
                         if ck != key:
@@ -439,9 +569,11 @@ class _ClientSession:
                                 "msgs": [message_to_dict(m) for m in batch]})
                 else:
                     def on_batch(batch, topic=topic):
+                        msgs = (batch if type(batch) is list
+                                else batch.messages())
                         self.push("fops", {
                             "topic": topic,
-                            "msgs": [message_to_dict(m) for m in batch]})
+                            "msgs": [message_to_dict(m) for m in msgs]})
                 server.pubsub.subscribe(topic, on_batch)
 
                 def on_signal(sig, topic=topic):

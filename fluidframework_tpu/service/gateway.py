@@ -413,7 +413,9 @@ class Gateway:
                         # hot path: rewrite submit → fsubmit by
                         # prepending the sid — op payloads are relayed,
                         # never decoded here
-                        if (len(body) >= 2 and body[1] == binwire.FT_SUBMIT
+                        if (len(body) >= 2
+                                and body[1] in (binwire.FT_SUBMIT,
+                                                binwire.FT_COLS_SUBMIT)
                                 and session.sid is not None
                                 and session.up is not None):
                             self.upstream_send_raw(binwire.frame(

@@ -139,16 +139,24 @@ class _AsyncClient:
             if delay > 0:
                 await asyncio.sleep(delay)
             ops = self.editor.next_ops(self.batch)
-            # latency is timed per boxcar on its last op. That op also
-            # carries a client trace stamp: deli's SAMPLED tracing only
-            # stamps pre-traced ops (deli.py fast lane), and the stamp is
-            # what brings the deli timestamp back for the hop split
-            # (submit→deli, deli→ack) computed locally on ack
-            ops[-1].traces.append(TraceHop(
-                service="client", action="submit", timestamp=time.time()))
+            # latency is timed per boxcar on its last op. Columnar
+            # frames carry no traces — the deli stamp timestamp in the
+            # sequenced frame IS the deli time for every record
+            # (scan_ops yields it), so the hop split needs no per-op
+            # trace. The rec-frame fallback keeps the client trace
+            # stamp: deli's SAMPLED tracing only stamps pre-traced ops
+            # (deli.py fast lane), and the stamp is what brings the
+            # deli timestamp back for the hop split (submit→deli,
+            # deli→ack) computed locally on ack
+            body = binwire.encode_submit_columns(ops)
+            if body is None:
+                ops[-1].traces.append(TraceHop(
+                    service="client", action="submit",
+                    timestamp=time.time()))
+                body = binwire.encode_submit(ops)
             self.pending[ops[-1].client_sequence_number] = (
                 time.perf_counter(), time.time())
-            self.writer.write(binwire.frame(binwire.encode_submit(ops)))
+            self.writer.write(binwire.frame(body))
             self.submitted += len(ops)
             await self.writer.drain()
 

@@ -15,15 +15,22 @@
 //     client submit  (ftype 1) -> upstream fsubmit (ftype 3, u32 sid spliced)
 //     upstream fops  (ftype 4) -> client ops (ftype 2, topic stripped),
 //                                 fanned out per topic subscriber
+//     columnar twins (ftype 5-8) relay IDENTICALLY: cols_submit (5) ->
+//     cols_fsubmit (6) by the same 6-byte sid splice, cols_fops (8) ->
+//     cols_ops (7) by the same topic strip — the column payload is
+//     never parsed on the relay path.
 //   JSON body  : {"t": ...}
 //     connect -> fconnect (sid assigned, bin:1 forced), fconnected ->
 //     connected; submit/signal/disconnect -> f*; storage RPCs forwarded
 //     with rid remapped; fnack/fsignal routed by sid/topic.
 //
-// Constraint (documented in gateway.py --native): clients must negotiate
-// the binary ops push ("bin":1 — the driver default). A JSON-ops legacy
-// client is refused at connect; the pure-Python gateway remains the
-// compatibility path.
+// Compatibility: clients SHOULD negotiate the binary ops push ("bin":1
+// — the driver default). Legacy JSON-ops clients are served too: each
+// binary broadcast batch is decoded to the JSON ops frame once per
+// topic (ops_body_to_json / cols_body_to_json below) and shared by
+// every legacy subscriber. A batch that cannot be decoded sends the
+// legacy session an error frame and closes it, so its reconnect +
+// delta backfill repairs the sequence gap instead of stalling on it.
 //
 // JSON handling is a shallow top-level scanner: keys + raw value spans.
 // Frames are REASSEMBLED from spans (never re-serialized), so payloads
@@ -54,6 +61,10 @@ constexpr uint8_t kFtSubmit = 1;
 constexpr uint8_t kFtOps = 2;
 constexpr uint8_t kFtFsubmit = 3;
 constexpr uint8_t kFtFops = 4;
+constexpr uint8_t kFtColsSubmit = 5;
+constexpr uint8_t kFtColsFsubmit = 6;
+constexpr uint8_t kFtColsOps = 7;
+constexpr uint8_t kFtColsFops = 8;
 constexpr size_t kMaxFrame = 8u * 1024 * 1024;     // front_end.py MAX_FRAME
 constexpr size_t kMaxBuffered = 32u * 1024 * 1024; // slow-consumer drop
 
@@ -385,6 +396,188 @@ std::string ops_body_to_json(const uint8_t* body, size_t len) {
   return out;
 }
 
+// Columnar ops decode for JSON-ops legacy clients. The column section
+// (binwire.py columnar layout) is LITTLE-endian by design — numpy-native
+// on the Python ends — so this reader is the LE twin of BinReader.
+
+struct LeReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint16_t u16() {
+    if (p + 2 > end) { ok = false; return 0; }
+    uint16_t v = (uint16_t)p[0] | ((uint16_t)p[1] << 8);
+    p += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (p + 4 > end) { ok = false; return 0; }
+    uint32_t v = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                 ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+    p += 4;
+    return v;
+  }
+  int64_t i64() {
+    uint64_t lo = u32(), hi = u32();
+    return (int64_t)((hi << 32) | lo);
+  }
+  double f64() {
+    uint64_t lo = u32(), hi = u32();
+    uint64_t bits = (hi << 32) | lo;
+    double d;
+    memcpy(&d, &bits, 8);
+    return d;
+  }
+  std::string bytes_str(size_t n) {
+    if (p + n > end) { ok = false; return std::string(); }
+    std::string s((const char*)p, n);
+    p += n;
+    return s;
+  }
+  bool skip(size_t n) {
+    if (p + n > end) { ok = false; return false; }
+    p += n;
+    return true;
+  }
+};
+
+int32_t rd_i32le(const uint8_t* p) {
+  return (int32_t)((uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                   ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24));
+}
+
+int64_t rd_i64le(const uint8_t* p) {
+  uint64_t lo = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+  uint64_t hi = (uint32_t)p[4] | ((uint32_t)p[5] << 8) |
+                ((uint32_t)p[6] << 16) | ((uint32_t)p[7] << 24);
+  return (int64_t)((hi << 32) | lo);
+}
+
+// text_off holds CHARACTER offsets into the utf8 text blob; map them to
+// byte offsets with a sequential walk (offsets are non-decreasing).
+struct Utf8Walker {
+  const char* s;
+  size_t len;
+  size_t byte = 0;
+  long long ch = 0;
+  size_t to_byte(long long target) {
+    while (ch < target && byte < len) {
+      unsigned char c = (unsigned char)s[byte];
+      byte += (c < 0x80) ? 1 : (c < 0xE0) ? 2 : (c < 0xF0) ? 3 : 4;
+      ch++;
+    }
+    return byte;
+  }
+};
+
+// Decode a cols_ops body (MAGIC kFtColsOps stamp cols msns) into the
+// exact {"t":"ops","msgs":[...]} frame front_end.py's JSON slot would
+// produce. Empty string on malformed input.
+std::string cols_body_to_json(const uint8_t* body, size_t len) {
+  LeReader r{body + 2, body + len};
+  std::string cid = r.bytes_str(r.u16());
+  int64_t base_seq = r.i64();
+  double ts = r.f64();
+  uint16_t n = r.u16();
+  std::string ds = r.bytes_str(r.u16());
+  std::string ch = r.bytes_str(r.u16());
+  if (!r.ok || n == 0) return std::string();
+  const uint8_t* kind = r.p;
+  if (!r.skip(n)) return std::string();
+  const uint8_t* a = r.p;
+  if (!r.skip(4ull * n)) return std::string();
+  const uint8_t* b = r.p;
+  if (!r.skip(4ull * n)) return std::string();
+  const uint8_t* cseq = r.p;
+  if (!r.skip(4ull * n)) return std::string();
+  const uint8_t* rseq = r.p;
+  if (!r.skip(4ull * n)) return std::string();
+  const uint8_t* text_off = r.p;
+  if (!r.skip(4ull * (n + 1))) return std::string();
+  uint32_t tlen = r.u32();
+  const char* text = (const char*)r.p;
+  if (!r.skip(tlen)) return std::string();
+  uint32_t plen = r.u32();
+  const char* props_raw = (const char*)r.p;
+  if (!r.skip(plen)) return std::string();
+  const uint8_t* msns = r.p;
+  if (!r.skip(8ull * n)) return std::string();
+  // split the per-op props array (JSON list of dict-or-null) into spans
+  std::vector<std::pair<const char*, size_t>> props_spans;
+  if (plen) {
+    const char* p = props_raw;
+    const char* pend = props_raw + plen;
+    while (p < pend && *p != '[') p++;
+    if (p >= pend) return std::string();
+    p++;
+    while (p < pend) {
+      while (p < pend && (*p == ' ' || *p == ',')) p++;
+      if (p < pend && *p == ']') break;
+      const char* vend = skip_value(p, pend);
+      if (!vend) return std::string();
+      props_spans.push_back({p, (size_t)(vend - p)});
+      p = vend;
+    }
+    if (props_spans.size() != n) return std::string();
+  }
+  Utf8Walker w{text, tlen};
+  std::string cid_json;
+  append_json_str(&cid_json, cid);
+  std::string out = "{\"t\":\"ops\",\"msgs\":[";
+  for (uint16_t i = 0; i < n; i++) {
+    uint8_t k = kind[i];
+    std::string op;
+    if (k == 0) {
+      long long c0 = rd_i32le(text_off + 4ull * i);
+      long long c1 = rd_i32le(text_off + 4ull * (i + 1));
+      if (c1 < c0) return std::string();
+      size_t b0 = w.to_byte(c0);
+      size_t b1 = w.to_byte(c1);
+      op = "{\"type\":0,\"pos\":" +
+           std::to_string(rd_i32le(a + 4ull * i)) + ",\"text\":";
+      append_json_str(&op, std::string(text + b0, b1 - b0));
+      op += "}";
+    } else if (k == 1) {
+      op = "{\"type\":1,\"start\":" +
+           std::to_string(rd_i32le(a + 4ull * i)) + ",\"end\":" +
+           std::to_string(rd_i32le(b + 4ull * i)) + "}";
+    } else if (k == 2) {
+      op = "{\"type\":2,\"start\":" +
+           std::to_string(rd_i32le(a + 4ull * i)) + ",\"end\":" +
+           std::to_string(rd_i32le(b + 4ull * i)) + ",\"props\":";
+      if (i < props_spans.size() && props_spans[i].second &&
+          *props_spans[i].first == '{')
+        op.append(props_spans[i].first, props_spans[i].second);
+      else
+        op += "{}";
+      op += "}";
+    } else {
+      return std::string();
+    }
+    if (i) out += ",";
+    out += "{\"_kind\":\"seq\",\"client_id\":" + cid_json;
+    out += ",\"sequence_number\":" + std::to_string(base_seq + i);
+    out += ",\"minimum_sequence_number\":" +
+           std::to_string(rd_i64le(msns + 8ull * i));
+    out += ",\"client_sequence_number\":" +
+           std::to_string(rd_i32le(cseq + 4ull * i));
+    out += ",\"reference_sequence_number\":" +
+           std::to_string(rd_i32le(rseq + 4ull * i));
+    out += ",\"type\":\"op\",\"contents\":{\"kind\":\"chanop\",\"address\":";
+    append_json_str(&out, ds);
+    out += ",\"contents\":{\"address\":";
+    append_json_str(&out, ch);
+    out += ",\"contents\":" + op + "}}";
+    out += ",\"metadata\":null,\"origin\":null";
+    out += ",\"timestamp\":";
+    append_double(&out, ts);
+    out += ",\"traces\":[]}";
+  }
+  out += "]}";
+  return out;
+}
+
 // --------------------------------------------------------------- sessions
 
 struct Session {
@@ -394,6 +587,10 @@ struct Session {
   bool binary = false;     // negotiated binwire ops push (bin:1)
   bool gated = false;      // connect in flight: buffer pushes
   std::vector<std::string> gate_buffer;
+  size_t gate_bytes = 0;   // gate_buffer total, counted toward the
+                           // slow-consumer bound (a gated session must
+                           // not buffer unboundedly just because its
+                           // connect reply is slow)
   std::string rbuf;        // partial inbound bytes
   std::deque<std::string> wq;  // pending outbound frames
   size_t wq_bytes = 0;
@@ -453,11 +650,16 @@ void close_session(Gateway* g, int fd, bool notify_core);
 void send_to(Gateway* g, Session* s, std::string frame) {
   if (s->dead) return;
   if (s->gated) {
+    s->gate_bytes += frame.size();
+    if (s->wq_bytes + s->gate_bytes > kMaxBuffered) {
+      s->dead = true;  // gated slow consumer: same bound as below
+      return;
+    }
     s->gate_buffer.push_back(std::move(frame));
     return;
   }
   s->wq_bytes += frame.size();
-  if (s->wq_bytes > kMaxBuffered) {
+  if (s->wq_bytes + s->gate_bytes > kMaxBuffered) {
     s->dead = true;  // slow consumer: drop (mirrors MAX_BUFFERED)
     return;
   }
@@ -646,13 +848,15 @@ void handle_client_json(Gateway* g, Session* s, const char* body, size_t len) {
 void handle_client_frame(Gateway* g, Session* s, const char* body,
                          size_t len) {
   if (len >= 2 && (uint8_t)body[0] == kMagic) {
-    if ((uint8_t)body[1] == kFtSubmit && s->sid != 0) {
-      // splice: 01 01 <batch> -> 01 03 u32sid <batch>
+    uint8_t ft = (uint8_t)body[1];
+    if ((ft == kFtSubmit || ft == kFtColsSubmit) && s->sid != 0) {
+      // splice: 01 01 <batch> -> 01 03 u32sid <batch>; the columnar
+      // twin is the identical rewrite (01 05 -> 01 06 u32sid)
       std::string out;
       out.reserve(len + 8 + 4);
       frame_header(&out, len + 4);
       out.push_back((char)kMagic);
-      out.push_back((char)kFtFsubmit);
+      out.push_back((char)(ft == kFtSubmit ? kFtFsubmit : kFtColsFsubmit));
       out.push_back((char)((s->sid >> 24) & 0xFF));
       out.push_back((char)((s->sid >> 16) & 0xFF));
       out.push_back((char)((s->sid >> 8) & 0xFF));
@@ -682,20 +886,23 @@ void fan_out(Gateway* g, const std::string& topic, const std::string& frame) {
 
 void handle_upstream_frame(Gateway* g, const char* body, size_t len) {
   if (len >= 2 && (uint8_t)body[0] == kMagic) {
-    if ((uint8_t)body[1] == kFtFops && len >= 4) {
-      // 01 04 u16 tlen topic <batch> -> topic, frame(01 02 <batch>)
+    uint8_t ft = (uint8_t)body[1];
+    if ((ft == kFtFops || ft == kFtColsFops) && len >= 4) {
+      // 01 04 u16 tlen topic <batch> -> topic, frame(01 02 <batch>);
+      // the columnar twin strips identically (01 08 -> 01 07)
       size_t tlen = ((size_t)(uint8_t)body[2] << 8) | (uint8_t)body[3];
       if (4 + tlen > len) return;
       std::string topic(body + 4, tlen);
       std::string ops_body;
       ops_body.reserve(len - 4 - tlen + 2);
       ops_body.push_back((char)kMagic);
-      ops_body.push_back((char)kFtOps);
+      ops_body.push_back((char)(ft == kFtFops ? kFtOps : kFtColsOps));
       ops_body.append(body + 4 + tlen, len - 4 - tlen);
       std::string bin_frame = make_frame(ops_body);
       auto it = g->topics.find(topic);
       if (it == g->topics.end()) return;
       std::string json_frame;  // lazily decoded once per batch
+      bool json_failed = false;
       std::vector<int> fds(it->second.begin(), it->second.end());
       for (int fd : fds) {
         auto sit = g->sessions.find(fd);
@@ -704,11 +911,23 @@ void handle_upstream_frame(Gateway* g, const char* body, size_t len) {
         if (s->binary) {
           send_to(g, s, bin_frame);
         } else {
-          if (json_frame.empty()) {
-            std::string j = ops_body_to_json(
-                (const uint8_t*)ops_body.data(), ops_body.size());
-            if (j.empty()) continue;  // undecodable: skip legacy clients
-            json_frame = make_frame(j);
+          if (json_frame.empty() && !json_failed) {
+            std::string j =
+                (ft == kFtFops)
+                    ? ops_body_to_json((const uint8_t*)ops_body.data(),
+                                       ops_body.size())
+                    : cols_body_to_json((const uint8_t*)ops_body.data(),
+                                        ops_body.size());
+            if (j.empty()) json_failed = true;
+            else json_frame = make_frame(j);
+          }
+          if (json_failed) {
+            // a silently skipped batch would stall this session on
+            // the seq gap forever — error + close instead, so its
+            // reconnect + delta backfill repairs the stream
+            send_error(g, s, "", "undecodable ops batch; reconnect");
+            s->dead = true;
+            continue;
           }
           send_to(g, s, json_frame);
         }
@@ -757,14 +976,21 @@ void handle_upstream_frame(Gateway* g, const char* body, size_t len) {
       if (is_error) {
         // refused connect: unregister, drop the gate buffer
         s->gate_buffer.clear();
+        s->gate_bytes = 0;
         s->gated = false;
         detach_session(g, s, false);
         send_to(g, s, make_frame(out));
       } else {
-        // deliver connected FIRST, then the gated pushes, then ungate
+        // deliver connected FIRST, then the gated pushes, then ungate.
+        // Each frame's bytes move from the gate account to the write
+        // queue account as it replays — decrement BEFORE send_to so
+        // the bound check never double-counts a frame mid-replay.
         s->gated = false;
         send_to(g, s, make_frame(out));
-        for (auto& fbuf : s->gate_buffer) send_to(g, s, std::move(fbuf));
+        for (auto& fbuf : s->gate_buffer) {
+          s->gate_bytes -= fbuf.size();
+          send_to(g, s, std::move(fbuf));
+        }
         s->gate_buffer.clear();
       }
     } else {

@@ -1,0 +1,345 @@
+"""Columnar ingress: codec equivalence, fallback nacks, splice stamping.
+
+The columnar fast path (protocol/binwire.py FT_COLS_*) must be an
+optimization, not a semantic fork: ``encode_submit_columns`` /
+``decode_submit_columns`` round-trip to exactly the DocumentMessages the
+rec-frame codec carries; every bulk-admission miss (unjoined client,
+clientSeq gap, oversize op) lands the identical nacks the scalar door
+produces; and the broadcast frame contains the ingress column bytes
+VERBATIM (the deli stamp is a splice, not a re-encode).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import time
+
+import pytest
+
+from fluidframework_tpu.driver import NetworkDocumentServiceFactory
+from fluidframework_tpu.protocol import binwire
+from fluidframework_tpu.protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    TraceHop,
+)
+from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+from fluidframework_tpu.service.core import QueuedMessage
+from fluidframework_tpu.service.array_batch import ArrayBoxcar
+from fluidframework_tpu.service.deli import DeliLambda, RawMessage
+
+
+def wait_for(pred, timeout=10.0, interval=0.005):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return bool(pred())
+
+
+def _chanop(op):
+    return {"kind": "chanop", "address": "default",
+            "contents": {"address": "text", "contents": op}}
+
+
+_POOL = ["a", "bc", "déf", "ghij", "héllo", "жopб", "x" * 40]
+
+
+def _rand_cols_ops(rng: random.Random, n: int, cseq0: int = 1) -> list:
+    """n random columnar-eligible ops on one channel."""
+    ops = []
+    rseq = rng.randrange(100)
+    for i in range(n):
+        r = rng.random()
+        if r < 0.5:
+            op = {"type": 0, "pos": rng.randrange(10_000),
+                  "text": rng.choice(_POOL)}
+        elif r < 0.8:
+            a = rng.randrange(10_000)
+            op = {"type": 1, "start": a, "end": a + 1 + rng.randrange(40)}
+        else:
+            a = rng.randrange(10_000)
+            op = {"type": 2, "start": a, "end": a + 2,
+                  "props": {"k": rng.randrange(4), "s": rng.choice(_POOL)}}
+        rseq += rng.randrange(3)
+        ops.append(DocumentMessage(
+            client_sequence_number=cseq0 + i,
+            reference_sequence_number=rseq,
+            type=MessageType.OPERATION, contents=_chanop(op)))
+    return ops
+
+
+def test_cols_roundtrip_equivalence_fuzz():
+    """decode(encode_submit_columns(ops)) materializes exactly the ops the
+    rec-frame codec round-trips — field-for-field."""
+    rng = random.Random(21)
+    for trial in range(50):
+        ops = _rand_cols_ops(rng, rng.randrange(1, 40))
+        body = binwire.encode_submit_columns(ops)
+        assert body is not None
+        assert binwire.is_binary(body)
+        sid, sc = binwire.decode_submit_columns(body)
+        assert sid is None
+        assert binwire.cols_to_ops(sc) == ops
+        # the rec-frame door carries the same messages
+        _, rec = binwire.decode_submit(binwire.encode_submit(ops))
+        assert rec == ops
+
+
+def test_cols_fsubmit_relay_equivalence():
+    """The gateway's 6-byte prepend relay equals direct sid encoding and
+    survives decode — same contract as the rec-frame family."""
+    rng = random.Random(22)
+    ops = _rand_cols_ops(rng, 12)
+    plain = binwire.encode_submit_columns(ops)
+    direct = binwire.encode_submit_columns(ops, sid=777)
+    assert binwire.submit_to_fsubmit(plain, 777) == direct
+    sid, sc = binwire.decode_submit_columns(direct)
+    assert sid == 777
+    assert binwire.cols_to_ops(sc) == ops
+
+
+def test_non_columnable_shapes_return_none():
+    """Every ineligible shape falls back (None) instead of mis-encoding."""
+    ok = _rand_cols_ops(random.Random(23), 3)
+    assert binwire.encode_submit_columns(ok) is not None
+
+    def variant(mutate):
+        ops = _rand_cols_ops(random.Random(23), 3)
+        mutate(ops)
+        return binwire.encode_submit_columns(ops)
+
+    assert variant(lambda o: setattr(o[1], "metadata", {"batch": True})) \
+        is None
+    assert variant(lambda o: o[1].traces.append(
+        TraceHop(service="client", action="submit", timestamp=1.0))) is None
+    assert variant(lambda o: setattr(o[1], "type", MessageType.NOOP)) is None
+    assert variant(lambda o: setattr(o[1], "contents",
+                                     {"kind": "attach", "blob": "x"})) is None
+    # second channel in the boxcar → not a single-channel column frame
+    assert variant(lambda o: o[1].contents["contents"].__setitem__(
+        "address", "other")) is None
+    # marker insert (extra key) and out-of-range int
+    assert variant(lambda o: o[1].contents["contents"]["contents"].update(
+        {"type": 0, "pos": 1, "text": "t", "marker": True})) is None
+    assert variant(lambda o: o[1].contents["contents"].__setitem__(
+        "contents", {"type": 0, "pos": 2**31, "text": "t"})) is None
+
+
+def test_stamp_is_verbatim_splice_and_decodes():
+    """stamp_cols_ops must contain the ingress column bytes unmodified,
+    and the stamped frame must decode/scan to the sequenced stream."""
+    rng = random.Random(24)
+    ops = _rand_cols_ops(rng, 9)
+    body = binwire.encode_submit_columns(ops)
+    _, sc = binwire.decode_submit_columns(body)
+    msns = list(range(92, 92 + 9))
+    stamped = binwire.stamp_cols_ops(sc.cols, "client-7", 100, msns, 1234.5)
+    assert sc.cols in stamped  # the splice invariant
+    topic, out = binwire.decode_cols_ops(stamped)
+    assert topic is None
+    assert [m.contents for m in out] == [m.contents for m in ops]
+    assert [m.sequence_number for m in out] == list(range(100, 109))
+    assert [m.minimum_sequence_number for m in out] == msns
+    assert all(m.client_id == "client-7" and m.timestamp == 1234.5
+               and m.type is MessageType.OPERATION for m in out)
+    # scan_ops agrees without materializing, and yields the stamp
+    # timestamp as every record's deli time
+    for m, (cid, seq, cseq, deli_ts, delta) in zip(
+            out, binwire.scan_ops(stamped)):
+        assert (cid, seq, cseq, deli_ts) == (
+            "client-7", m.sequence_number, m.client_sequence_number, 1234.5)
+        op = m.contents["contents"]["contents"]
+        if op["type"] == 0:
+            assert delta == len(op["text"])
+        elif op["type"] == 1:
+            assert delta == op["start"] - op["end"]
+        else:
+            assert delta == 0
+    # fops twin strips back to the identical ops frame
+    fops = binwire.stamp_cols_ops(sc.cols, "client-7", 100, msns, 1234.5,
+                                  topic="op/t/doc")
+    t, stripped = binwire.fops_strip_topic(fops)
+    assert t == "op/t/doc" and stripped == stamped
+
+
+class _Capture:
+    def __init__(self):
+        self.sequenced = []
+        self.nacks = []
+
+    def send(self, msg):
+        self.sequenced.append(msg)
+
+    def send_batch(self, batch):
+        if isinstance(batch, list):
+            self.sequenced.extend(batch)
+        else:
+            self.sequenced.extend(batch.messages())
+
+    def nack(self, client_id, nack):
+        self.nacks.append((client_id, nack))
+
+
+def _cols_boxcar(ops) -> ArrayBoxcar:
+    """An ArrayBoxcar exactly as the columnar ingress door builds it."""
+    _, sc = binwire.decode_submit_columns(binwire.encode_submit_columns(ops))
+    return ArrayBoxcar(
+        tenant_id="t", document_id="d", client_id="",
+        ds_id=sc.ds_id, channel_id=sc.channel_id, kind=sc.kind,
+        a=sc.a, b=sc.b, cseq=sc.cseq, rseq=sc.rseq,
+        text=sc.text, text_off=sc.text_off, props=sc.props,
+        wire_cols=sc.cols)
+
+
+def test_bulk_admission_misses_nack_like_scalar():
+    """Unjoined client and clientSeq gap through the columnar-built
+    ArrayBoxcar produce the identical sequenced stream + nacks the
+    scalar lane produces for the same ops."""
+    rng = random.Random(25)
+    join = RawMessage("t", "d", None, DocumentMessage(
+        -1, -1, MessageType.CLIENT_JOIN, {"clientId": "a"}), 1000.0)
+    good = _rand_cols_ops(rng, 4, cseq0=1)
+    gap = _rand_cols_ops(rng, 3, cseq0=9)       # expected 5, got 9
+    ghost = _rand_cols_ops(rng, 2, cseq0=1)     # never joined
+
+    def feed(cap, columnar: bool):
+        deli = DeliLambda("t", "d", send_sequenced=cap.send,
+                          send_nack=cap.nack, clock=lambda: 1000.0,
+                          send_sequenced_batch=cap.send_batch)
+        records = [join]
+        for cid, ops in (("a", good), ("a", gap), ("ghost", ghost)):
+            if columnar:
+                box = _cols_boxcar(ops)
+                box.client_id = cid
+                box.timestamp = 1001.0
+                records.append(box)
+            else:
+                records.extend(RawMessage("t", "d", cid, op, 1001.0)
+                               for op in ops)
+        for off, rec in enumerate(records):
+            deli.handler(QueuedMessage(off + 1, "raw", 0, rec))
+        return deli
+
+    cap_c, cap_s = _Capture(), _Capture()
+    deli = feed(cap_c, columnar=True)
+    feed(cap_s, columnar=False)
+    assert deli.boxcars_fast == 1        # the good boxcar rode the lane
+    assert deli.boxcars_fallback == 2    # gap + ghost fell back
+    key = lambda m: (m.client_id, m.sequence_number,
+                     m.minimum_sequence_number, m.client_sequence_number,
+                     m.reference_sequence_number, m.type, repr(m.contents))
+    assert [key(m) for m in cap_c.sequenced] \
+        == [key(m) for m in cap_s.sequenced]
+    assert [(c, n.code, n.type, n.message) for c, n in cap_c.nacks] \
+        == [(c, n.code, n.type, n.message) for c, n in cap_s.nacks]
+    assert cap_c.nacks  # the misses really nacked
+
+
+@pytest.fixture
+def front_end():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    yield fe
+    fe.stop()
+
+
+def test_oversize_nack_identical_through_either_door(front_end, monkeypatch):
+    """An over-limit op in a columnar frame nacks exactly like the same
+    op through the rec-frame door (shared _filter_oversized)."""
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    big = DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION,
+        contents=_chanop({"type": 0, "pos": 0, "text": "x" * 20_000}))
+
+    def drive(doc):
+        conn = factory.create_document_service(
+            "t", doc).connect_to_delta_stream()
+        nacks = []
+        conn.on_nack = nacks.append
+        conn.submit([big])
+        assert wait_for(lambda: nacks)
+        conn.close()
+        return nacks[0]
+
+    n_cols = drive("doc-cols")
+    srv = front_end.counters.snapshot()
+    assert srv.get("net.ingress.fallback", 0) >= 1  # failed the fast bound
+    monkeypatch.setattr(binwire, "encode_submit_columns",
+                        lambda ops, sid=None: None)
+    n_rec = drive("doc-rec")
+    assert (n_cols.code, n_cols.type, n_cols.message) \
+        == (n_rec.code, n_rec.type, n_rec.message)
+    assert n_cols.code == 413
+    assert n_cols.operation.client_sequence_number \
+        == n_rec.operation.client_sequence_number == 1
+
+
+def _frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+def test_stamped_splice_reaches_subscribers_through_fanout(front_end):
+    """A columnar submit's column bytes appear VERBATIM inside the
+    binwire broadcast every subscriber receives, and the second
+    subscriber is served from the encode-once cache."""
+    ops = _rand_cols_ops(random.Random(26), 8)
+    body = binwire.encode_submit_columns(ops)
+    _, sc = binwire.decode_submit_columns(body)
+
+    def connect(doc):
+        s = socket.create_connection(("127.0.0.1", front_end.port),
+                                     timeout=10)
+        s.sendall(_frame({"t": "connect", "tenant": "t", "doc": doc,
+                          "rid": 1, "bin": 1}))
+        return s
+
+    s1, s2 = connect("doc"), connect("doc")
+    bufs = {s1: b"", s2: b""}
+
+    def read_frame(s):
+        while True:
+            buf = bufs[s]
+            if len(buf) >= 4:
+                n = int.from_bytes(buf[:4], "big")
+                if len(buf) >= 4 + n:
+                    bufs[s] = buf[4 + n:]
+                    return buf[4:4 + n]
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed")
+            bufs[s] += chunk
+
+    for s in (s1, s2):  # drain the connect reply (JSON)
+        while binwire.is_binary(read_frame(s)):
+            pass
+    s1.sendall(binwire.frame(body))
+
+    def next_cols(s):
+        while True:
+            f = read_frame(s)
+            if binwire.is_binary(f) and f[1] in (binwire.FT_COLS_OPS,
+                                                 binwire.FT_COLS_FOPS):
+                return f
+
+    b1, b2 = next_cols(s1), next_cols(s2)
+    assert b1 == b2                 # encode-once: both got the same bytes
+    assert sc.cols in b1            # the submit's columns, unmodified
+    _, msgs = binwire.decode_ops(b1)
+    assert [m.contents for m in msgs] == [m.contents for m in ops]
+    assert [m.client_sequence_number for m in msgs] \
+        == [m.client_sequence_number for m in ops]
+    assert [m.sequence_number for m in msgs] \
+        == list(range(msgs[0].sequence_number,
+                      msgs[0].sequence_number + len(ops)))
+    # the broadcast bytes can reach the sockets before the server loop
+    # executes its post-batch counter increments — poll, don't snapshot
+    snap = front_end.counters.snapshot
+    assert wait_for(lambda: snap().get("net.ingress.columnar", 0) >= 1)
+    assert wait_for(lambda: snap().get("net.fanout.cache_hits", 0) >= 1)
+    s1.close()
+    s2.close()

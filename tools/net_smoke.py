@@ -10,6 +10,9 @@ amortization points of the socket tier (see ARCHITECTURE.md
   ride FEWER frames than ops;
 - a raw socket delivering many frames in one TCP wave — the server's
   drain-batched read loop must count ``net.ingress.coalesced``;
+- a burst of canonical chanop boxcars — the driver must emit columnar
+  frames (``driver.submit.columnar``) and the server must admit them
+  through the array lane (``net.ingress.columnar``);
 - two subscribers on one doc — the encode-once fan-out must count
   ``net.fanout.cache_hits``;
 - a read-only frame after quiescence — ``net.flush.elided`` must rise,
@@ -30,6 +33,7 @@ import tempfile
 import time
 
 N_OPS = 200
+N_COLS = 64
 BURST_FRAMES = 16
 
 
@@ -66,6 +70,16 @@ def main() -> int:
             client_sequence_number=cseq, reference_sequence_number=0,
             type=MessageType.OPERATION, contents={"i": i})
 
+    def chan_op(cseq: int, i: int) -> DocumentMessage:
+        # canonical chanop envelope — eligible for the columnar fast path
+        return DocumentMessage(
+            client_sequence_number=cseq, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"kind": "chanop", "address": "default",
+                      "contents": {"address": "text",
+                                   "contents": {"type": 0, "pos": 0,
+                                                "text": f"c{i}"}}})
+
     tmp = tempfile.mkdtemp(prefix="net-smoke-")
     front = NetworkFrontEnd(
         LocalServer(log=DurableLog(os.path.join(tmp, "log")))
@@ -93,6 +107,17 @@ def main() -> int:
                     and delivered(seen2, conn1.client_id, N_OPS)):
         print("net_smoke: FAIL — coalesced burst did not converge "
               f"({len(seen1)}/{len(seen2)} of {N_OPS})", file=sys.stderr)
+        return 1
+
+    # columnar burst: canonical chanop boxcars must ride the array lane
+    # (driver encodes columns once, server admits without per-op decode)
+    for i in range(N_COLS):
+        conn1.submit([chan_op(N_OPS + i + 1, i)])
+    want = N_OPS + N_COLS
+    if not wait_for(lambda: delivered(seen1, conn1.client_id, want)
+                    and delivered(seen2, conn1.client_id, want)):
+        print("net_smoke: FAIL — columnar burst did not converge "
+              f"({len(seen1)}/{len(seen2)} of {want})", file=sys.stderr)
         return 1
 
     # raw socket: many frames in ONE TCP wave — the drain-batched read
@@ -136,7 +161,9 @@ def main() -> int:
     srv = front.counters.snapshot()
     checks = {
         "driver.submit.coalesced": drv.get("driver.submit.coalesced", 0),
+        "driver.submit.columnar": drv.get("driver.submit.columnar", 0),
         "net.ingress.coalesced": srv.get("net.ingress.coalesced", 0),
+        "net.ingress.columnar": srv.get("net.ingress.columnar", 0),
         "net.fanout.cache_hits": srv.get("net.fanout.cache_hits", 0),
         "net.flush.performed": srv.get("net.flush.performed", 0),
         "net.flush.elided": srv.get("net.flush.elided", 0),

