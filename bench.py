@@ -316,6 +316,44 @@ def _query_counters(port: int) -> dict:
         return {}
 
 
+def _query_hop_breakdown(port: int) -> dict:
+    """Per-hop-pair observation counts from the core's labeled metrics
+    registry (admin_metrics_scrape RPC, Prometheus text): the published
+    proof that every tier stamped — a refactor that silently drops a
+    TraceHop stamp shows up here as a missing pair, not as a latency
+    mystery two rounds later."""
+    import socket
+
+    from fluidframework_tpu.obs import parse_prometheus
+
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            body = json.dumps(
+                {"t": "admin_metrics_scrape", "rid": 1}).encode()
+            s.sendall(len(body).to_bytes(4, "big") + body)
+
+            def read_exactly(n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = s.recv(n - len(buf))
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    buf += chunk
+                return buf
+
+            while True:
+                n = int.from_bytes(read_exactly(4), "big")
+                frame = json.loads(read_exactly(n).decode())
+                if frame.get("rid") == 1:
+                    series = parse_prometheus(frame.get("scrape", ""))
+                    return {
+                        dict(k).get("pair"): v
+                        for k, v in series.get(
+                            "fluid_obs_hop_ms_count", {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
 def bench_network() -> dict:
     """Socket load against a front-end PROCESS: at-load op-ack latency.
 
@@ -340,8 +378,8 @@ def bench_network() -> dict:
 
     def run_workers(ports: list, nworkers: int, docs: int, cpd: int,
                     rate: float, batch: int, rounds: int, prefix: str,
-                    start_margin: float = 6.0, timeout: float = 300.0
-                    ) -> dict:
+                    start_margin: float = 6.0, timeout: float = 300.0,
+                    extra: tuple = ()) -> dict:
         start_at = _time.time() + start_margin
         workers = [
             subprocess.Popen(
@@ -352,14 +390,14 @@ def bench_network() -> dict:
                           "--rounds", str(rounds), "--batch", str(batch),
                           "--rate", str(rate), "--seed", str(w),
                           "--start-at", str(start_at),
-                          "--doc-prefix", f"{prefix}w{w}d"),
+                          "--doc-prefix", f"{prefix}w{w}d", *extra),
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 text=True, cwd=REPO, env=_lean_env())
             for w in range(nworkers)
         ]
         lats, ops, acked, secs, errors = [], 0, 0, 0.0, []
         late = 0.0
-        hops = {"submit_to_deli": [], "deli_to_ack": []}
+        hops: dict = {}
         for w in workers:
             out, _ = w.communicate(timeout=timeout)
             r = json.loads(out)
@@ -369,8 +407,8 @@ def bench_network() -> dict:
             secs = max(secs, r["seconds"])
             late = max(late, r.get("late_s", 0.0))
             errors.extend(r.get("errors", []))
-            for k in hops:
-                hops[k].extend(r["hops"].get(k, []))
+            for k, v in r["hops"].items():
+                hops.setdefault(k, []).extend(v)
         assert acked == ops, (acked, ops, errors[:3])
 
         def pct(vals, p):
@@ -456,6 +494,25 @@ def bench_network() -> dict:
         # engaged under load, reported as net_batching
         batching = _query_counters(port)
 
+        # per-hop-pair counts from the core's metrics registry over the
+        # same window: the knee runs went through gateways with 1-in-16
+        # trace sampling armed, so all four server-visible legs (submit→
+        # relay→admit→deli→fanout) must have counted
+        hop_breakdown = _query_hop_breakdown(port)
+
+        # armed/disarmed A/B at the knee rate: the sampling knob must
+        # cost ~nothing when off AND ~nothing at 1-in-16 — two
+        # back-to-back runs, same geometry, published side by side
+        rounds = max(8, int(8 * knee_rate))
+        trace_ab = {
+            "armed_ops_per_sec": run_workers(
+                knee_ports, 4, 64, 2, knee_rate, 32, rounds,
+                "abarm")["ops_per_sec"],
+            "disarmed_ops_per_sec": run_workers(
+                knee_ports, 4, 64, 2, knee_rate, 32, rounds,
+                "aboff", extra=("--trace-sample-n", "0"))["ops_per_sec"],
+        }
+
         # ---- BASELINE config 4: 1000 docs × 10 clients, 4 gateways.
         # The 10× fan-out geometry has its own (lower) knee: step the
         # per-client rate down until the p99 target holds. If even the
@@ -517,6 +574,8 @@ def bench_network() -> dict:
             "net_10k_docs": n10k,
             "sharded": sharded,
             "batching": batching,
+            "hop_breakdown": hop_breakdown,
+            "trace_ab": trace_ab,
         }
     finally:
         for gw, _ in gws:
@@ -652,6 +711,14 @@ def main() -> None:
                 "net_batching": {
                     k: v for k, v in net.get("batching", {}).items()
                     if k.startswith("net.")},
+                # per-hop-pair observation counts scraped from the core's
+                # metrics registry (admin_metrics_scrape) over the knee
+                # window: every server-visible leg must have counted
+                "net_hop_breakdown": net.get("hop_breakdown", {}),
+                # trace sampling armed (1-in-16) vs disarmed at the knee
+                # rate: the two throughputs must sit within run-to-run
+                # noise of each other
+                "net_trace_ab": net.get("trace_ab", {}),
             }
         )
     )

@@ -12,6 +12,7 @@ one CLI against the ordering core's admin frames (front_end.py
     python -m fluidframework_tpu.admin tenant-rm ID --port P
     python -m fluidframework_tpu.admin monitor --port P [--interval S]
                                                [--count N]
+    python -m fluidframework_tpu.admin metrics --port P
 
 ``monitor`` is the service-monitor role (ref: server/service-monitor):
 each tick it measures the front door's ping RTT (event-loop health) and
@@ -99,6 +100,8 @@ def main(argv=None) -> int:
     s.add_argument("--interval", type=float, default=2.0)
     s.add_argument("--count", type=int, default=0,
                    help="ticks before exiting (0 = forever)")
+    sub.add_parser("metrics",
+                   help="Prometheus text scrape of the core's registry")
     args = p.parse_args(argv)
 
     if args.cmd == "monitor":
@@ -111,6 +114,9 @@ def main(argv=None) -> int:
             print(f"no live pipeline for {args.tenant}/{args.doc}")
             return 1
         print(json.dumps(reply["status"], indent=2))
+    elif args.cmd == "metrics":
+        reply = _request(args, {"t": "admin_metrics_scrape"})
+        sys.stdout.write(reply["scrape"])
     elif args.cmd == "docs":
         reply = _request(args, {"t": "admin_docs"})
         for d in reply["docs"]:

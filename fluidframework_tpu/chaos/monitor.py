@@ -31,6 +31,7 @@ import hashlib
 import json
 from typing import Optional
 
+from ..obs import get_recorder, tier_counters
 from ..protocol.messages import MessageType
 from ..utils.telemetry import Counters
 
@@ -53,7 +54,8 @@ def doc_fingerprint(text: str, props: list[dict]) -> str:
 class InvariantMonitor:
     def __init__(self, counters: Optional[Counters] = None,
                  dedupe: bool = True):
-        self.counters = counters if counters is not None else Counters()
+        self.counters = (counters if counters is not None
+                         else tier_counters("chaos"))
         self.dedupe = dedupe
         self.violations: list[str] = []
         self.last_seq = 0
@@ -172,7 +174,15 @@ class InvariantMonitor:
 
     def _violate(self, msg: str) -> None:
         self.violations.append(msg)
-        self.counters.inc("chaos.violations")
+        self.counters.inc("chaos.invariants.violated")
+        if len(self.violations) == 1:
+            # first violation triggers the flight-recorder dump: the
+            # event/frame rings still hold what led up to it (later
+            # violations are usually the same failure cascading)
+            try:
+                get_recorder().dump("invariant_violation", detail=msg)
+            except Exception:
+                pass
 
     def check(self) -> None:
         if self.violations:

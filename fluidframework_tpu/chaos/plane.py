@@ -27,6 +27,7 @@ import random
 from collections import defaultdict
 from typing import Any, Callable, Optional
 
+from ..obs import tier_counters
 from ..utils.telemetry import Counters
 
 #: injection point → boundary class, for the per-class coverage check
@@ -94,7 +95,8 @@ class FaultPlane:
     def __init__(self, seed: int = 0, counters: Optional[Counters] = None):
         self.seed = seed
         self.rng = random.Random(seed)
-        self.counters = counters if counters is not None else Counters()
+        self.counters = (counters if counters is not None
+                         else tier_counters("chaos"))
         self.rules: list[FaultRule] = []
         self.armed = True
         self.calls: dict[str, int] = defaultdict(int)
@@ -138,7 +140,7 @@ class FaultPlane:
                 if isinstance(v, (str, int, float, bool)) or v is None}
         self.injected.append((point, directive, lite))
         self.counters.inc(f"chaos.injected.{point}.{directive}")
-        self.counters.inc("chaos.injected")
+        self.counters.inc("chaos.faults.injected")
 
     # -------------------------------------------------------- introspection
 

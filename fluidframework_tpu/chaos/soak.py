@@ -35,9 +35,11 @@ import sys
 import tempfile
 import time
 from dataclasses import replace
+from typing import Optional
 
 from ..mergetree.client import MergeTreeClient
 from ..mergetree.ops import op_to_wire
+from ..obs import get_recorder, tier_counters
 from ..protocol.messages import DocumentMessage, MessageType
 from ..utils.telemetry import Counters
 from .hooks import install
@@ -752,7 +754,7 @@ def _cross_check(counters: Counters) -> None:
 
 def run_soak(seed: int, quick: bool = False, break_dedupe: bool = False,
              no_recover: bool = False, phases: str = "ab") -> dict:
-    counters = Counters()
+    counters = tier_counters("chaos")
     planes = []
     monitors = []
     if "a" in phases:
@@ -770,14 +772,38 @@ def run_soak(seed: int, quick: bool = False, break_dedupe: bool = False,
     coverage = _check_coverage(planes) if phases == "ab" else \
         planes[0].injected_by_class()
     _cross_check(counters)
+    flight_dump = _check_flight_dump(counters) if "a" in phases else None
     return {
         "seed": seed,
         "coverage": coverage,
         "observed": sum(m.observed for m in monitors),
         "redelivered": sum(m.redelivered for m in monitors),
+        "flight_dump": flight_dump,
         "counters": {k: v for k, v in sorted(counters.snapshot().items())
                      if k.startswith("chaos.")},
     }
+
+
+def _check_flight_dump(counters: Counters) -> Optional[str]:
+    """Phase A injects an orderer crash (stage.crash → orderer_hard); the
+    crash path must have dumped the flight recorder, and the dump's tail
+    must carry the telemetry preceding the crash — a dump that exists but
+    is empty would be a recorder that armed too late to matter."""
+    if counters.snapshot().get(
+            "chaos.injected.stage.crash.orderer_hard", 0) == 0:
+        return None
+    path = get_recorder().last_dump
+    if path is None or not os.path.exists(path):
+        raise InvariantViolation(
+            "orderer crash injected but no flight-recorder dump written")
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    header = json.loads(lines[0]) if lines else {}
+    if header.get("flight") != "orderer_crash" or len(lines) < 2:
+        raise InvariantViolation(
+            f"flight dump {path} missing the pre-crash telemetry tail "
+            f"(header={header.get('flight')}, lines={len(lines)})")
+    return path
 
 
 def main(argv=None) -> int:
@@ -799,7 +825,12 @@ def main(argv=None) -> int:
                           break_dedupe=args.break_dedupe,
                           no_recover=args.no_recover, phases=args.phases)
     except InvariantViolation as e:
-        print(f"SOAK FAILED (seed {args.seed}): {e}", file=sys.stderr)
+        # attach the flight-recorder dump (if one fired) so the failure
+        # report carries the telemetry that preceded the trigger
+        dump = get_recorder().last_dump
+        where = f"\n  flight recorder: {dump}" if dump else ""
+        print(f"SOAK FAILED (seed {args.seed}): {e}{where}",
+              file=sys.stderr)
         return 1
     print(json.dumps(result, indent=2))
     return 0

@@ -32,10 +32,11 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..obs import tier_counters
 from ..protocol import binwire
-from ..protocol.messages import MessageType
+from ..protocol.messages import MessageType, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
-from ..utils.telemetry import Counters
+from ..utils.telemetry import HOP_SUBMIT, Counters
 from .definitions import (
     DocumentDeltaConnection,
     DocumentDeltaStorage,
@@ -264,7 +265,14 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         self._tenant = tenant_id
         self._doc = document_id
         self._cache = cache
-        self.counters = counters if counters is not None else Counters()
+        self.counters = (counters if counters is not None
+                         else tier_counters("driver"))
+        #: 1-in-N submit tracing (0 = disarmed): every Nth boxcar gets a
+        #: client/submit hop — columnar frames via the 9-byte hoptail
+        #: append, rec frames via a TraceHop on the last op — so arming
+        #: costs one counter increment per flush, not per op
+        self.trace_sample_n = 0
+        self._trace_seq = 0
         self._handlers: dict[str, Optional[Callable]] = {
             "op": None, "nack": None, "signal": None}
         self._buffers: dict[str, list] = {"op": [], "nack": [], "signal": []}
@@ -357,7 +365,12 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         cseq = getattr(messages[-1], "client_sequence_number", None)
         if cseq is not None:
             if len(self._inflight_ts) > 256:
-                self._inflight_ts.clear()
+                # evict only the OLDEST entry (dicts are insertion-
+                # ordered): wiping the whole map here discarded every
+                # in-flight ack-latency sample under a deep burst and
+                # froze the coalescing EWMA at its pre-burst value
+                del self._inflight_ts[next(iter(self._inflight_ts))]
+                self.counters.inc("driver.inflight.evicted")
             self._inflight_ts[cseq] = time.monotonic()
         with self._coal_cv:
             if self._coal_closed:
@@ -439,6 +452,10 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def _send_ops(self, ops: list) -> None:
         for i in range(0, len(ops), _MAX_BOXCAR_OPS):
             chunk = ops[i:i + _MAX_BOXCAR_OPS]
+            sample = False
+            if self.trace_sample_n:
+                self._trace_seq += 1
+                sample = self._trace_seq % self.trace_sample_n == 0
             # columnar first: a canonical chanop boxcar rides the
             # fixed-stride column frame the server admits without
             # materializing per-op objects (kind stays "submit" so the
@@ -447,7 +464,19 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             body = binwire.encode_submit_columns(chunk)
             if body is not None:
                 columnar = True
+                if sample:
+                    # hoptail append keeps the op columns untouched —
+                    # stamping traces on the op itself would kick the
+                    # boxcar off the columnar path entirely
+                    body = binwire.append_hop(
+                        body, HOP_SUBMIT, time.time())
+                    self.counters.inc("driver.trace.sampled")
             else:
+                if sample:
+                    chunk[-1].traces.append(TraceHop(
+                        service="client", action="submit",
+                        timestamp=time.time()))
+                    self.counters.inc("driver.trace.sampled")
                 try:
                     body = binwire.encode_submit(chunk)
                 except Exception:
@@ -617,7 +646,8 @@ class NetworkDocumentService(DocumentService):
         self._token_provider = token_provider
         self._binary = binary
         self._cache = cache
-        self.counters = counters if counters is not None else Counters()
+        self.counters = (counters if counters is not None
+                         else tier_counters("driver"))
         self._rpc: Optional[_Transport] = None
 
     def _rpc_transport(self) -> _Transport:
@@ -663,8 +693,11 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
         # stats/assertions
         self.snapshot_cache = SnapshotCache() if snapshot_cache else None
         # one Counters shared by every connection of this factory, so
-        # bench/soak/tests can assert submit coalescing engaged
-        self.counters = counters if counters is not None else Counters()
+        # bench/soak/tests can assert submit coalescing engaged; the
+        # registry-vended instance also surfaces in the metrics scrape
+        # under tier="driver"
+        self.counters = (counters if counters is not None
+                         else tier_counters("driver"))
 
     def create_document_service(
         self, tenant_id: str, document_id: str

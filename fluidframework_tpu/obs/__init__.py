@@ -1,0 +1,19 @@
+"""Observability plane (SURVEY §telemetry): labeled metrics registry
+with a Prometheus scrape, and the crash flight recorder.
+
+Hot paths keep their per-instance ``Counters``; this package is the
+process-wide aggregation and post-mortem layer over them.
+"""
+
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    reset_recorder,
+)
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus,
+    reset_registry,
+    tier_counters,
+)

@@ -1,0 +1,280 @@
+"""Process-wide metrics registry with labels and a Prometheus scrape.
+
+Ref: services/src/metricClient.ts ships counters to an external
+telegraf; SURVEY §telemetry prescribes labeled series. Our tiers until
+now each held a private :class:`~fluidframework_tpu.utils.telemetry.
+Counters` surfaced ad hoc through ``admin_counters`` — attribution
+stopped at whichever instance a test or bench happened to hold. This
+module is the process-wide aggregation point:
+
+- ``tier_counters(tier)`` hands a tier a FRESH ``Counters`` instance
+  (hot paths keep their lock-free dict increments — nothing on the op
+  path touches the registry) and registers it, weakly, under the tier
+  label; the scrape sums same-named counters across live instances.
+- ``inc``/``set_gauge``/``observe`` are the labeled direct API
+  (``tenant``/``doc``/``pair``/``tier`` label keys) for the few cold
+  call sites that want per-entity series.
+- Label-set cardinality is BOUNDED per metric name: past ``max_series``
+  distinct label sets, samples land in a single overflow bucket
+  (``overflow="true"``) and ``obs.series.dropped`` counts the spills —
+  a hostile tenant-id stream cannot grow the scrape without bound.
+- ``scrape()`` renders Prometheus text exposition (counters as
+  ``counter``, gauges as ``gauge``, observations as ``summary`` with
+  p50/p99 quantile labels); :func:`parse_prometheus` is the matching
+  reader used by tools/net_smoke.py and bench.py.
+
+Dotted metric names (``tier.noun.verb`` — enforced by the fluidlint
+``metric-name`` pass) map to Prometheus by ``.`` → ``_`` with a
+``fluid_`` prefix.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional
+
+from ..utils.telemetry import Counters, percentile
+
+#: Distinct label sets allowed per metric name before overflow.
+DEFAULT_MAX_SERIES = 256
+
+_PREFIX = "fluid_"
+
+
+def _prom_name(name: str) -> str:
+    return _PREFIX + name.replace(".", "_").replace("-", "_")
+
+
+def _prom_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in labels)
+    return "{" + inner + "}"
+
+
+class _Series:
+    """One observation series: true count + bounded sample list."""
+
+    __slots__ = ("count", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.samples: list[float] = []
+
+    def add(self, value: float, max_samples: int = 4096) -> None:
+        self.count += 1
+        if len(self.samples) < max_samples:
+            self.samples.append(value)
+
+
+class MetricsRegistry:
+    """The process-wide labeled metric store (see module docstring)."""
+
+    def __init__(self, max_series: int = DEFAULT_MAX_SERIES):
+        self._lock = threading.Lock()
+        self._max_series = max_series
+        # name -> {sorted-label-tuple -> value}
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
+        self._observations: dict[str, dict[tuple, _Series]] = {}
+        # (tier, weakref-to-Counters) — scrape aggregates the live ones
+        self._tiers: list[tuple[str, weakref.ref]] = []
+        self.series_dropped = 0
+
+    # ------------------------------------------------------------ write API
+
+    def _labelset(self, table: dict, name: str, labels: dict) -> tuple:
+        """The bounded label key for (name, labels) — the overflow
+        bucket once the name's cardinality budget is spent."""
+        key = tuple(sorted(labels.items()))
+        series = table.setdefault(name, {})
+        if key not in series and len(series) >= self._max_series:
+            self.series_dropped += 1
+            return (("overflow", "true"),)
+        return key
+
+    def inc(self, name: str, by: float = 1, **labels) -> None:
+        with self._lock:
+            key = self._labelset(self._counters, name, labels)
+            table = self._counters[name]
+            table[key] = table.get(key, 0) + by
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            key = self._labelset(self._gauges, name, labels)
+            self._gauges[name][key] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            key = self._labelset(self._observations, name, labels)
+            series = self._observations[name].setdefault(key, _Series())
+            series.add(value)
+
+    def register_tier(self, tier: str, counters: Counters) -> None:
+        """Track a tier's Counters weakly: the hot path keeps writing
+        its private instance, the scrape reads whatever is still
+        alive."""
+        with self._lock:
+            self._tiers = [(t, r) for t, r in self._tiers
+                           if r() is not None]
+            self._tiers.append((tier, weakref.ref(counters)))
+
+    # ------------------------------------------------------------- read API
+
+    def _tier_snapshot(self) -> tuple[dict, dict]:
+        """Aggregate registered tier Counters → (counts, observations),
+        both keyed (name, (("tier", t),))."""
+        counts: dict[tuple, float] = {}
+        obs: dict[tuple, _Series] = {}
+        with self._lock:
+            live = [(t, r()) for t, r in self._tiers]
+        for tier, c in live:
+            if c is None:
+                continue
+            key = (("tier", tier),)
+            # list() the views: the owning tier keeps mutating its
+            # instance while we read
+            for name, v in list(c._counts.items()):
+                counts[(name, key)] = counts.get((name, key), 0) + v
+            for name, vals in list(c._values.items()):
+                s = obs.setdefault((name, key), _Series())
+                s.count += c._observed[name]
+                s.samples.extend(list(vals))
+        return counts, obs
+
+    def scrape(self) -> str:
+        """Prometheus text exposition of everything the process knows."""
+        tier_counts, tier_obs = self._tier_snapshot()
+        with self._lock:
+            counters = {n: dict(t) for n, t in self._counters.items()}
+            gauges = {n: dict(t) for n, t in self._gauges.items()}
+            observations = {n: dict(t)
+                            for n, t in self._observations.items()}
+            dropped = self.series_dropped
+        for (name, key), v in tier_counts.items():
+            counters.setdefault(name, {})
+            counters[name][key] = counters[name].get(key, 0) + v
+        for (name, key), s in tier_obs.items():
+            observations.setdefault(name, {})
+            have = observations[name].setdefault(key, _Series())
+            have.count += s.count
+            have.samples.extend(s.samples)
+        counters.setdefault("obs.series.dropped", {})[()] = (
+            counters.get("obs.series.dropped", {}).get((), 0) + dropped)
+
+        lines: list[str] = []
+        for name in sorted(counters):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} counter")
+            for key in sorted(counters[name]):
+                lines.append(
+                    f"{pn}{_prom_labels(key)} {counters[name][key]:g}")
+        for name in sorted(gauges):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            for key in sorted(gauges[name]):
+                lines.append(
+                    f"{pn}{_prom_labels(key)} {gauges[name][key]:g}")
+        for name in sorted(observations):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} summary")
+            for key in sorted(observations[name]):
+                s = observations[name][key]
+                vals = sorted(s.samples)
+                for q in (0.5, 0.99):
+                    lines.append(
+                        f"{pn}{_prom_labels(key + (('quantile', q),))} "
+                        f"{percentile(vals, q):g}")
+                lines.append(
+                    f"{pn}_count{_prom_labels(key)} {s.count:g}")
+                lines.append(
+                    f"{pn}_sum{_prom_labels(key)} {sum(s.samples):g}")
+        return "\n".join(lines) + "\n"
+
+
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (lazily constructed singleton)."""
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = MetricsRegistry()
+    return _registry
+
+
+def reset_registry() -> None:
+    """Drop the singleton (test isolation only)."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+def tier_counters(tier: str) -> Counters:
+    """A fresh per-instance ``Counters`` registered under ``tier``.
+
+    THE way production code obtains a Counters (the fluidlint
+    ``metric-name`` pass bans bare ``Counters()`` construction outside
+    this module): call sites keep their instance semantics and their
+    lock-free hot path, and the process scrape sees every live
+    instance, summed per (name, tier).
+    """
+    c = Counters()
+    get_registry().register_tier(tier, c)
+    return c
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse text exposition → {name: {label-tuple: value}}.
+
+    The reader half of :meth:`MetricsRegistry.scrape` (quantile labels
+    included verbatim), used by tools/net_smoke.py and bench.py to
+    consume ``admin_metrics_scrape`` output without a client library.
+    """
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, sval = line.rsplit(None, 1)
+            value = float(sval)
+        except ValueError:
+            raise ValueError(f"unparseable prometheus sample: {line!r}")
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            if not rest.endswith("}"):
+                raise ValueError(f"unterminated label set: {line!r}")
+            labels = []
+            body = rest[:-1]
+            while body:
+                k, body = body.split("=", 1)
+                if not body.startswith('"'):
+                    raise ValueError(f"unquoted label value: {line!r}")
+                # find the closing quote, honoring backslash escapes
+                i, esc, out_chars = 1, False, []
+                while i < len(body):
+                    ch = body[i]
+                    if esc:
+                        out_chars.append(ch)
+                        esc = False
+                    elif ch == "\\":
+                        esc = True
+                    elif ch == '"':
+                        break
+                    else:
+                        out_chars.append(ch)
+                    i += 1
+                labels.append((k, "".join(out_chars)))
+                body = body[i + 1:].lstrip(",")
+            key = tuple(labels)
+        else:
+            name, key = metric, ()
+        out.setdefault(name, {})[key] = value
+    return out

@@ -68,6 +68,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.telemetry import HOP_SERVICE_ACTION
 from .messages import (
     DocumentMessage,
     MessageType,
@@ -93,6 +94,7 @@ _TRACE = struct.Struct(">HHd")              # svc_idx, act_idx, ts
 _INS_HDR = struct.Struct(">HHI")            # ds, ch, pos
 _SPAN = struct.Struct(">HHII")              # ds, ch, start, end
 _FSUB_HDR = struct.Struct(">BBI")           # magic, ftype, sid
+_HOP = struct.Struct(">Bd")                 # hoptail entry: hop id, unix ts
 
 _NONE_IDX = 0xFFFF
 _MAX_U32 = 0xFFFFFFFF
@@ -464,7 +466,7 @@ def scan_ops(body: bytes):
     """
     ftype = body[1]
     if ftype == FT_COLS_OPS or ftype == FT_COLS_FOPS:
-        _, cid, base_seq, ts, sc, _msns = _read_cols_stamp(body)
+        _, cid, base_seq, ts, sc, _msns, _hops = _read_cols_stamp(body)
         kind = sc.kind
         delta = np.where(
             kind == 0, np.diff(sc.text_off),
@@ -557,6 +559,18 @@ def scan_ops(body: bytes):
 # owns text[text_off[i]:text_off[i+1]]). Record i's sequence number in a
 # stamped frame is base_seq + i; the stamp timestamp is deli's ticket
 # time for the whole batch (replaces per-record trace hops).
+#
+# Every cols-family body additionally ends in a hop trailer:
+#
+#     hoptail := k × (u8 hop_id, f64 ts)  u8 k      (big-endian)
+#
+# The count byte comes LAST so a relay tier appends its hop WITHOUT
+# parsing any frame content: read body[-1], splice 9 bytes before it,
+# bump the count (append_hop). Unsampled frames carry k = 0 — a single
+# NUL byte — so the disarmed hot-path cost is one byte per frame. Hop
+# ids index utils.telemetry.HOPS (the taxonomy's single source of
+# truth). The trailer sits OUTSIDE the ``cols`` section, so the deli
+# stamp splice and the encode-once fan-out caches never touch it.
 #
 # The load-bearing property: deli stamping is a byte SPLICE — the ops
 # frame embeds the submit frame's ``cols`` bytes VERBATIM between the
@@ -669,6 +683,56 @@ def _read_cols(body: bytes, off: int) -> tuple[SubmitColumns, int]:
                          props, body[start:off]), off
 
 
+def _hoptail(hops) -> bytes:
+    """Pack an ordered [(hop_id, ts), ...] list as the trailing hoptail."""
+    if not hops:
+        return b"\x00"
+    hops = hops[-0xFF:]
+    return b"".join(_HOP.pack(int(h), float(t)) for h, t in hops) \
+        + bytes((len(hops),))
+
+
+def append_hop(body: bytes, hop_id: int, ts: float) -> bytes:
+    """Splice one hop into a cols-family body's trailing hoptail.
+
+    The relay-tier stamp: no frame content is parsed — the count byte
+    at body[-1] moves back 9 bytes and increments. Full tails (255
+    hops) drop the stamp rather than corrupt the frame.
+    """
+    k = body[-1]
+    if k >= 0xFF:
+        return body
+    return b"".join((body[:-1], _HOP.pack(hop_id, ts), bytes((k + 1,))))
+
+
+def read_hoptail(body: bytes, end: Optional[int] = None):
+    """Parse the trailing hoptail → [(hop_id, ts), ...] in stamp order.
+
+    ``end`` — the content end offset, when the caller just parsed the
+    body — validates the trailer exactly. Without it the count byte is
+    trusted but bounds-checked; inconsistent tails (frames predating
+    the trailer in durable replays, chaos truncation) yield [] rather
+    than raising.
+    """
+    if not body:
+        return []
+    k = body[-1]
+    tail = 1 + k * _HOP.size
+    if end is not None and len(body) - end != tail:
+        return []
+    off = len(body) - tail
+    if off < 2:
+        return []
+    return [_HOP.unpack_from(body, off + i * _HOP.size) for i in range(k)]
+
+
+def hops_to_traces(hops) -> list[TraceHop]:
+    """Materialize hoptail entries as TraceHop objects (rec-frame shape)."""
+    return [TraceHop(service=HOP_SERVICE_ACTION[h][0],
+                     action=HOP_SERVICE_ACTION[h][1], timestamp=t)
+            for h, t in hops if 0 <= h < len(HOP_SERVICE_ACTION)]
+
+
 def encode_submit_columns(ops: list[DocumentMessage], *,
                           sid: Optional[int] = None) -> Optional[bytes]:
     """Encode a submit boxcar as a columnar frame, or None if ineligible.
@@ -743,12 +807,14 @@ def encode_submit_columns(ops: list[DocumentMessage], *,
         return None
     hdr = (bytes((MAGIC, FT_COLS_SUBMIT)) if sid is None
            else _FSUB_HDR.pack(MAGIC, FT_COLS_FSUBMIT, sid))
-    return hdr + cols
+    return hdr + cols + b"\x00"
 
 
-def decode_submit_columns(body: bytes) -> tuple[Optional[int],
-                                                SubmitColumns]:
-    """Decode a cols_submit/cols_fsubmit body → (sid or None, columns)."""
+def decode_submit_columns(body: bytes, *, with_hops: bool = False):
+    """Decode a cols_submit/cols_fsubmit body → (sid or None, columns).
+
+    ``with_hops=True`` appends the parsed hoptail as a third element.
+    """
     ftype = body[1]
     if ftype == FT_COLS_FSUBMIT:
         (sid,) = _U32.unpack_from(body, 2)
@@ -757,7 +823,9 @@ def decode_submit_columns(body: bytes) -> tuple[Optional[int],
         sid, off = None, 2
     else:
         raise ValueError(f"not a columnar submit frame (ftype {ftype})")
-    sc, _ = _read_cols(body, off)
+    sc, end = _read_cols(body, off)
+    if with_hops:
+        return sid, sc, read_hoptail(body, end)
     return sid, sc
 
 
@@ -792,13 +860,16 @@ def cols_to_ops(sc: SubmitColumns) -> list[DocumentMessage]:
 
 
 def stamp_cols_ops(cols: bytes, client_id: str, base_seq: int, msns,
-                   timestamp: float, *, topic: Optional[str] = None
-                   ) -> bytes:
+                   timestamp: float, *, topic: Optional[str] = None,
+                   hops=None) -> bytes:
     """Build a cols_ops/cols_fops body by SPLICING the submit's columns.
 
     ``cols`` is the column section exactly as received (SubmitColumns.
-    cols); only the stamp header and the msn tail are packed fresh —
-    this is deli's sequence/msn stamping as a vectorized byte splice.
+    cols); only the stamp header, the msn tail, and the hoptail are
+    packed fresh — this is deli's sequence/msn stamping as a vectorized
+    byte splice. ``hops`` is the accumulated [(hop_id, ts), ...] list
+    carried from the submit frame through the tiers (empty/None on
+    unsampled batches: the tail is a single NUL byte).
     """
     cid = client_id.encode()
     if topic is None:
@@ -813,12 +884,13 @@ def stamp_cols_ops(cols: bytes, client_id: str, base_seq: int, msns,
         np.array([timestamp], "<f8").tobytes(),
         cols,
         np.ascontiguousarray(msns, "<i8").tobytes(),
+        _hoptail(hops),
     ))
 
 
 def _read_cols_stamp(body: bytes):
     """Parse a stamped columnar body → (topic, cid, base_seq, ts, sc,
-    msns)."""
+    msns, hops)."""
     ftype = body[1]
     if ftype == FT_COLS_FOPS:
         (tl,) = _U16.unpack_from(body, 2)
@@ -838,7 +910,8 @@ def _read_cols_stamp(body: bytes):
     off += 8
     sc, off = _read_cols(body, off)
     msns = np.frombuffer(body, "<i8", sc.n, off)
-    return topic, cid, base_seq, ts, sc, msns
+    hops = read_hoptail(body, off + 8 * sc.n)
+    return topic, cid, base_seq, ts, sc, msns, hops
 
 
 def decode_cols_ops(body: bytes) -> tuple[Optional[str],
@@ -849,7 +922,7 @@ def decode_cols_ops(body: bytes) -> tuple[Optional[str],
     legacy JSON fan-out): hot subscribers consume the frame bytes or
     the SequencedArrayBatch directly and never call this.
     """
-    topic, cid, base_seq, ts, sc, msns = _read_cols_stamp(body)
+    topic, cid, base_seq, ts, sc, msns, hops = _read_cols_stamp(body)
     kind = sc.kind.tolist()
     a = sc.a.tolist()
     b = sc.b.tolist()
@@ -864,6 +937,10 @@ def decode_cols_ops(body: bytes) -> tuple[Optional[str],
         type=_OP_TYPE, contents=_cols_contents(sc, kind, a, b, toff, i),
         timestamp=ts)
         for i in range(len(kind))]
+    if hops:
+        # frame-level hops ride the LAST record, mirroring the client
+        # convention of stamping the final op of a sampled boxcar
+        msgs[-1].traces = hops_to_traces(hops)
     return topic, msgs
 
 

@@ -69,6 +69,12 @@ class ArrayBoxcar:
     # durable codecs below (a replayed boxcar re-encodes on demand).
     wire_cols: Optional[bytes] = field(default=None, repr=False,
                                        compare=False)
+    # accumulated trace hops [(hop_id, ts), ...] from the frame's
+    # hoptail (sampled boxcars only; None when tracing is unarmed).
+    # Each tier APPENDS its hop in place; the egress encode packs the
+    # list back into the broadcast frame's hoptail. Transport-only,
+    # like wire_cols: deliberately outside the durable codecs.
+    hops: Optional[list] = field(default=None, repr=False, compare=False)
 
     @property
     def n(self) -> int:

@@ -7,10 +7,12 @@ in production; in-proc PubSub here, memory-orderer/src/pubsub.ts:39).
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 from typing import Callable
 
-from ..protocol.messages import SequencedDocumentMessage
+from ..protocol.messages import SequencedDocumentMessage, TraceHop
+from ..utils.telemetry import HOP_FANOUT, HOP_SERVICE_ACTION
 from .core import QueuedMessage
 
 
@@ -62,6 +64,7 @@ class BroadcasterLambda:
             batch = envelope.get("boxcar")
         if batch is None:
             batch = [envelope["message"]]
+        self._stamp_fanout(batch)
         topic = self.topic(envelope["tenant_id"], envelope["document_id"])
         if self.fault_plane is not None:
             directive = self.fault_plane("broadcast.publish", topic=topic)
@@ -76,5 +79,25 @@ class BroadcasterLambda:
                 self._pubsub.publish(topic, batch)
         self._pubsub.publish(topic, batch)
 
-    def close(self) -> None:
-        pass
+    @staticmethod
+    def _stamp_fanout(batch) -> None:
+        """Stamp broadcast/fanout on SAMPLED traffic only.
+
+        Array batches carry the accumulated hoptail on the boxcar
+        (appended in place — the egress encode packs it); rec batches
+        carry per-message TraceHop lists, stamped only where a hop
+        list already exists (the client's sampling decision rides the
+        presence of traces). Unsampled traffic takes one branch here.
+        """
+        hops = getattr(getattr(batch, "boxcar", None), "hops", None)
+        if hops is not None:
+            hops.append((HOP_FANOUT, time.time()))
+            return
+        if isinstance(batch, list):
+            svc, act = HOP_SERVICE_ACTION[HOP_FANOUT]
+            for msg in batch:
+                traces = getattr(msg, "traces", None)
+                if traces:
+                    traces.append(
+                        TraceHop(service=svc, action=act,
+                                 timestamp=time.time()))

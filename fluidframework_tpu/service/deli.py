@@ -41,6 +41,7 @@ from ..protocol.messages import (
     SequencedDocumentMessage,
     TraceHop,
 )
+from ..utils.telemetry import HOP_DELI
 from .array_batch import ArrayBoxcar, SequencedArrayBatch
 from .core import QueuedMessage
 
@@ -469,6 +470,10 @@ class DeliLambda:
         client.reference_sequence_number = int(rseq[-1])
         client.last_update = now
         self.boxcars_fast += 1
+        if box.hops is not None:
+            # sampled boxcar: the stamp timestamp IS deli's ticket time
+            # (matches what scan_ops reports as deli_ts for cols frames)
+            box.hops.append((HOP_DELI, now))
         batch = SequencedArrayBatch(boxcar=box, base_seq=base_seq,
                                     msns=msns, timestamp=now)
         if self._send_batch is not None:

@@ -54,12 +54,14 @@ import asyncio
 import functools
 import json
 import threading
+import time
 from typing import Any, Optional
 
+from ..obs import get_recorder, get_registry, tier_counters
 from ..protocol import binwire
-from ..protocol.messages import Nack, NackErrorType
+from ..protocol.messages import Nack, NackErrorType, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
-from ..utils.telemetry import Counters
+from ..utils.telemetry import HOP_ADMIT, HOP_SERVICE_ACTION, hop_pairs
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
 
@@ -76,15 +78,39 @@ def _stamp_abatch(batch, topic=None) -> bytes:
     """Sequenced columnar broadcast body: splice deli's stamp onto the
     column bytes the submit frame carried (zero re-encode); a boxcar
     that arrived without them (in-proc submit_array, durable replay)
-    re-packs its columns once here."""
+    re-packs its columns once here.
+
+    Sampled boxcars carry the accumulated hop list; this is the egress
+    point where the full server-side path is known, so the consecutive
+    hop pairs (submit→relay→admit→deli→fanout) are observed into the
+    process registry here — once per encode, which the fan-out caches
+    make once per batch — and the list packs back into the broadcast
+    frame's hoptail for the client's ack split."""
     box = batch.boxcar
     cols = box.wire_cols
     if cols is None:
         cols = binwire.encode_cols(
             box.ds_id, box.channel_id, box.kind, box.a, box.b,
             box.cseq, box.rseq, box.text, box.text_off, box.props)
+    hops = box.hops
+    if hops:
+        reg = get_registry()
+        for pair, ms in hop_pairs(hops):
+            reg.observe("obs.hop.ms", ms, pair=pair)
     return binwire.stamp_cols_ops(cols, box.client_id, batch.base_seq,
-                                  batch.msns, batch.timestamp, topic=topic)
+                                  batch.msns, batch.timestamp, topic=topic,
+                                  hops=hops)
+
+
+def _stamp_admit(ops) -> None:
+    """frontend/admit hop on rec-frame ingress, SAMPLED ops only: an op
+    carries traces iff the client armed tracing for it, so unsampled
+    traffic pays one empty-list check per op."""
+    svc, act = HOP_SERVICE_ACTION[HOP_ADMIT]
+    for op in ops:
+        if op.traces:
+            op.traces.append(
+                TraceHop(service=svc, action=act, timestamp=time.time()))
 
 
 async def _read_body(reader: asyncio.StreamReader) -> Optional[bytes]:
@@ -349,8 +375,8 @@ class _ClientSession:
             elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
                 self._handle_gateway(t, frame, rid)
             elif t in ("admin_status", "admin_docs", "admin_tenants",
-                       "admin_counters", "admin_tenant_add",
-                       "admin_tenant_remove"):
+                       "admin_counters", "admin_metrics_scrape",
+                       "admin_tenant_add", "admin_tenant_remove"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -375,6 +401,7 @@ class _ClientSession:
                     body, with_spans=True)
                 ops = self._filter_oversized(ops, len(body), None)
                 if ops:
+                    _stamp_admit(ops)
                     # expose the splice context for the SYNCHRONOUS
                     # broadcast this submit triggers: the encoder reuses
                     # the submitted payload bytes instead of re-packing
@@ -390,6 +417,7 @@ class _ClientSession:
                 conn = self._fsessions[sid]
                 ops = self._filter_oversized(ops, len(body), sid)
                 if ops:
+                    _stamp_admit(ops)
                     self.front._splice_ctx = (spans, blob, npool)
                     try:
                         conn.submit(ops)
@@ -455,7 +483,7 @@ class _ClientSession:
         views) carrying the frame's column bytes for splice-stamped
         fan-out (``_push_abatch``)."""
         front = self.front
-        sid, sc = binwire.decode_submit_columns(body)
+        sid, sc, hops = binwire.decode_submit_columns(body, with_hops=True)
         if sid is None:
             conn = self.conn
             if conn is None:
@@ -465,12 +493,16 @@ class _ClientSession:
         limit = front.max_message_size
         if (getattr(conn, "can_write", True)
                 and 6 * len(body) + 512 <= limit):
+            if hops:
+                # sampled frame: stamp frontend/admit; downstream tiers
+                # append to the same list and the egress encode packs it
+                hops.append((HOP_ADMIT, time.time()))
             box = ArrayBoxcar(
                 tenant_id="", document_id="", client_id="",
                 ds_id=sc.ds_id, channel_id=sc.channel_id,
                 kind=sc.kind, a=sc.a, b=sc.b, cseq=sc.cseq, rseq=sc.rseq,
                 text=sc.text, text_off=sc.text_off, props=sc.props,
-                wire_cols=sc.cols)
+                wire_cols=sc.cols, hops=hops or None)
             conn.submit_array(box)
             front.counters.inc("net.ingress.columnar")
         else:
@@ -734,6 +766,11 @@ class _ClientSession:
             # soak can assert coalescing/flush-eliding actually engaged
             self.push("admin", {"rid": rid,
                                 "counters": front.counters.snapshot()})
+        elif t == "admin_metrics_scrape":
+            # read-only: the process-wide registry as Prometheus text —
+            # every live tier Counters plus the labeled hop-pair series
+            self.push("admin", {"rid": rid,
+                                "scrape": get_registry().scrape()})
         elif t == "admin_tenant_add":
             if tenants is None:
                 from .tenants import TenantManager
@@ -983,8 +1020,9 @@ class NetworkFrontEnd:
         self._batch_cache: tuple = (None, [None, None])
         self._fops_cache: tuple = (None, b"")
         # socket-tier batching telemetry (net.ingress.*, net.flush.*,
-        # net.fanout.*), served read-only by the admin_counters RPC
-        self.counters = Counters()
+        # net.fanout.*), served read-only by the admin_counters RPC and
+        # aggregated under tier="frontend" by the registry scrape
+        self.counters = tier_counters("frontend")
         # partition servers dirtied by the current ingress batch; the
         # batch flushes exactly these (see _flush_dirty)
         self._dirty_servers: set = set()
@@ -1079,6 +1117,8 @@ class NetworkFrontEnd:
         session = _ClientSession(self, writer)
         self._sessions.add(session)
         counters = self.counters
+        recorder = get_recorder()
+        conn_id = f"fe-{id(session) & 0xFFFFFF:06x}"
         try:
             while True:
                 body = await _read_body(reader)
@@ -1093,6 +1133,7 @@ class NetworkFrontEnd:
                 n = 0
                 while body is not None:
                     n += 1
+                    recorder.frame(conn_id, "in", body)
                     if binwire.is_binary(body):
                         session.handle_binary(body)
                     else:
@@ -1115,6 +1156,16 @@ class NetworkFrontEnd:
             pass  # malformed stream: drop the connection
         except (ConnectionResetError, BrokenPipeError):
             pass  # client died mid-frame: treat like a clean close
+        except Exception as e:  # noqa: BLE001 — unhandled tier failure:
+            # the per-frame handlers catch their own errors, so anything
+            # arriving here escaped the serving machinery itself. Dump
+            # the flight rings before dropping the connection.
+            self.logger.error("conn_unhandled", message=str(e))
+            try:
+                recorder.dump("frontend_unhandled", conn=conn_id,
+                              error=str(e))
+            except Exception:
+                pass
         finally:
             self._sessions.discard(session)
             session.closed()

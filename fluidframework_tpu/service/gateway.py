@@ -34,9 +34,12 @@ import asyncio
 import itertools
 import json
 import socket as _socket
+import time
 from typing import Optional
 
+from ..obs import get_recorder
 from ..protocol import binwire
+from ..utils.telemetry import HOP_RELAY
 from .front_end import _encode_frame, _frame_buffered, _read_body
 
 
@@ -396,6 +399,8 @@ class Gateway:
         if sock is not None:
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         session = _GatewaySession(self, writer)
+        recorder = get_recorder()
+        conn_id = f"gw-{id(session) & 0xFFFFFF:06x}"
         try:
             while True:
                 body = await _read_body(reader)
@@ -409,6 +414,7 @@ class Gateway:
                 n = 0
                 while body is not None:
                     n += 1
+                    recorder.frame(conn_id, "in", body)
                     if binwire.is_binary(body):
                         # hot path: rewrite submit → fsubmit by
                         # prepending the sid — op payloads are relayed,
@@ -418,6 +424,13 @@ class Gateway:
                                                 binwire.FT_COLS_SUBMIT)
                                 and session.sid is not None
                                 and session.up is not None):
+                            if (body[1] == binwire.FT_COLS_SUBMIT
+                                    and body[-1]):
+                                # sampled frame (hoptail count > 0):
+                                # append gateway/relay in place —
+                                # unsampled frames cost one byte read
+                                body = binwire.append_hop(
+                                    body, HOP_RELAY, time.time())
                             self.upstream_send_raw(binwire.frame(
                                 binwire.submit_to_fsubmit(body,
                                                           session.sid)),
@@ -443,6 +456,16 @@ class Gateway:
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception as e:  # noqa: BLE001 — unhandled tier failure:
+            # dump the flight recorder so the frames preceding the
+            # escape are preserved for post-mortem
+            try:
+                recorder.dump("gateway_unhandled",
+                              conn=conn_id, error=str(e))
+            except Exception:
+                pass
         finally:
             session.detach()
             try:

@@ -26,10 +26,12 @@ import json
 import random
 import socket as _socket
 import time
+from collections import defaultdict
 from typing import Optional
 
 from ..protocol import binwire
 from ..protocol.messages import TraceHop
+from ..utils.telemetry import HOP_ACK, HOP_SUBMIT, hop_pairs
 from .synthetic import SyntheticEditor
 
 
@@ -37,12 +39,17 @@ class _AsyncClient:
     """One synthetic client: connection + editor + pacing schedule."""
 
     def __init__(self, host: str, port: int, tenant: str, doc: str,
-                 rng: random.Random, batch: int, rounds: int):
+                 rng: random.Random, batch: int, rounds: int,
+                 trace_sample_n: int = 0):
         self.host, self.port = host, port
         self.tenant, self.doc = tenant, doc
         self.editor = SyntheticEditor(rng)
         self.batch = batch
         self.rounds = rounds
+        #: 1-in-N columnar boxcar tracing (0 = disarmed): sampled frames
+        #: carry the hoptail, and _observe folds it into the full
+        #: per-tier breakdown instead of the two-leg deli split
+        self.trace_sample_n = trace_sample_n
         # random phase spreads the fleet across the round period —
         # without it every client submits at the same instant and the
         # measurement becomes burst queueing, not steady-state load
@@ -53,9 +60,9 @@ class _AsyncClient:
         self.lat_ms: list[float] = []
         self.acked = 0
         self.submitted = 0
-        # per-hop splits computed locally from the record's deli stamp
-        self.hops: dict[str, list] = {"submit_to_deli": [],
-                                      "deli_to_ack": []}
+        # per-hop splits: the two-leg deli split from the record's deli
+        # stamp, or the full hoptail breakdown on sampled cols frames
+        self.hops: dict[str, list] = defaultdict(list)
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.error: Optional[str] = None
@@ -99,6 +106,12 @@ class _AsyncClient:
         workers' largest CPU item at the knee."""
         me = self.client_id
         ed = self.editor
+        # a sampled cols frame carries the accumulated hoptail at its
+        # end (one boxcar = one submitting client, so the hops are ours
+        # exactly when a record below matches our pending cseq)
+        frame_hops = (binwire.read_hoptail(body)
+                      if len(body) >= 2
+                      and body[1] == binwire.FT_COLS_OPS else [])
         for cid, seq, cseq, deli_ts, delta in binwire.scan_ops(body):
             ed.ref_seq = seq
             if cid is None or me is None:
@@ -109,8 +122,16 @@ class _AsyncClient:
                 if t0 is not None:
                     now = time.perf_counter()
                     self.lat_ms.append((now - t0[0]) * 1e3)
-                    if deli_ts is not None:
-                        wall = time.time()
+                    wall = time.time()
+                    if frame_hops:
+                        # full breakdown: local submit/ack close the
+                        # chain; the frame's own submit stamp (later in
+                        # the list) wins over the local t0 fallback
+                        for name, ms in hop_pairs(
+                                [(HOP_SUBMIT, t0[1])] + list(frame_hops)
+                                + [(HOP_ACK, wall)]):
+                            self.hops[name].append(ms)
+                    elif deli_ts is not None:
                         self.hops["submit_to_deli"].append(
                             (deli_ts - t0[1]) * 1e3)
                         self.hops["deli_to_ack"].append(
@@ -154,6 +175,13 @@ class _AsyncClient:
                     service="client", action="submit",
                     timestamp=time.time()))
                 body = binwire.encode_submit(ops)
+            elif self.trace_sample_n \
+                    and i % self.trace_sample_n == 0:
+                # arm the hoptail on every Nth columnar boxcar: tiers
+                # append their hops in place and the ack broadcast
+                # brings the chain back for the local breakdown
+                body = binwire.append_hop(
+                    body, HOP_SUBMIT, time.time())
             self.pending[ops[-1].client_sequence_number] = (
                 time.perf_counter(), time.time())
             self.writer.write(binwire.frame(body))
@@ -172,11 +200,13 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
                    doc_prefix: str, tenant: str = "bench",
                    connect_concurrency: int = 64,
                    timeout: float = 120.0,
-                   start_at: Optional[float] = None) -> dict:
+                   start_at: Optional[float] = None,
+                   trace_sample_n: int = 0) -> dict:
     rng = random.Random(seed)
     clients = [
         _AsyncClient(host, port, tenant, f"{doc_prefix}{d}",
-                     random.Random(rng.random()), batch, rounds)
+                     random.Random(rng.random()), batch, rounds,
+                     trace_sample_n=trace_sample_n)
         for d in range(n_docs) for _ in range(clients_per_doc)
     ]
     # staged connects: a 10k-connection stampede overruns the listen
@@ -214,11 +244,12 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
     seconds = time.perf_counter() - t0
 
     lat = []
-    hops: dict[str, list] = {"submit_to_deli": [], "deli_to_ack": []}
+    hops: dict[str, list] = defaultdict(list)
     for c in clients:
         lat.extend(c.lat_ms)
         for name, vals in c.hops.items():
             hops[name].extend(vals)
+    hops = dict(hops)
     for r in readers:
         r.cancel()
     for c in clients:
@@ -252,6 +283,9 @@ def main() -> None:
     p.add_argument("--doc-prefix", default="netdoc")
     p.add_argument("--start-at", type=float, default=None,
                    help="wall-clock epoch at which to start submitting")
+    p.add_argument("--trace-sample-n", type=int, default=16,
+                   help="arm the hoptail on every Nth columnar boxcar "
+                        "(0 disables tracing)")
     args = p.parse_args()
 
     # the worker's op path allocates acyclic graphs only; the cycle
@@ -264,7 +298,7 @@ def main() -> None:
     result = asyncio.run(run_load(
         args.host, args.port, args.docs, args.clients_per_doc,
         args.rounds, args.batch, args.rate, args.seed, args.doc_prefix,
-        start_at=args.start_at))
+        start_at=args.start_at, trace_sample_n=args.trace_sample_n))
     json.dump(result, sys.stdout)
     print()
 

@@ -169,6 +169,12 @@ class LocalServer:
             client_timeout = self.config.client_timeout_s
         # sink-less by default: zero cost until a host injects a sink
         self.logger = logger if logger is not None else TelemetryLogger("service")
+        # always-on flight-recorder rings (obs/flight.py): per-boxcar
+        # admission events land here so a crash dump carries the traffic
+        # that preceded it
+        from ..obs import get_recorder
+
+        self._flight = get_recorder()
         # any object with the LocalLog surface works — pass a DurableLog
         # to persist the pipeline across process restarts
         self.log = log if log is not None else LocalLog()
@@ -374,11 +380,23 @@ class LocalServer:
         checkpoint. Deli replays the raw log from its checkpointed
         offset and re-tickets the window with identical sequence
         numbers; downstream consumers dedupe by seq (the chaos soak's
-        stage-crash fault)."""
+        stage-crash fault). An injected crash is a flight-recorder
+        trigger: the rings dump so the run carries the traffic that
+        preceded the kill."""
+        from ..obs import get_recorder
+
         key = f"{tenant_id}/{document_id}"
         orderer = self._orderers.pop(key, None)
         if orderer is not None:
             orderer.close()
+        recorder = get_recorder()
+        recorder.event("deli", "orderer_crash", tenant=tenant_id,
+                       doc=document_id)
+        try:
+            recorder.dump("orderer_crash", tenant=tenant_id,
+                          doc=document_id)
+        except OSError:
+            pass  # a failed dump must not break the crash simulation
         self._get_orderer(tenant_id, document_id)
 
     # ------------------------------------------------------------- internal
@@ -419,6 +437,8 @@ class LocalServer:
             return
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)
         now = self._clock()
+        self._flight.event("deli", "boxcar", doc=conn.document_id,
+                           client=conn.client_id, n=len(messages))
         # the whole submitted batch rides the raw log as ONE boxcar record
         # (ref: IBoxcarMessage); deli's fast lane tickets it in one pass
         orderer.order(
@@ -448,6 +468,8 @@ class LocalServer:
         boxcar.document_id = conn.document_id
         boxcar.client_id = conn.client_id
         boxcar.timestamp = self._clock()
+        self._flight.event("deli", "aboxcar", doc=conn.document_id,
+                           client=conn.client_id, n=boxcar.n)
         orderer = self._get_orderer(conn.tenant_id, conn.document_id)
         orderer.order(boxcar)
         self._maybe_drain()

@@ -79,6 +79,17 @@ _DENSE_STEP_CACHE: dict = {}
 _PACK_SYSTEM = np.int16(32767)
 
 
+def _count_trace(kernel: str, shape: str) -> None:
+    """Runs at TRACE time only (a Python side effect inside a jitted
+    body): each call is one XLA recompile of ``kernel`` for a new shape
+    bucket. The registry series makes kernel-count swings between runs
+    attributable (tools/profile_applier.py prints the breakdown)."""
+    from ..obs import get_registry
+
+    get_registry().inc("applier.kernel.recompiled",
+                       kernel=kernel, shape=shape)
+
+
 def _dense_step_for(D: int, K: int, use_pallas: bool = False,
                     pallas_interpret: bool = False):
     """The wave arrives PACKED from the host: int16[D, K, F] deltas plus
@@ -127,11 +138,13 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
                  w[..., F_KEY], w[..., F_VAL]], axis=-1)
 
         def dense_step(state, wave16, bases):
+            _count_trace("dense_step", f"{D}x{K}")
             wave = unpack(wave16, bases)
             state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
         def dense_step_wide(state, wave):
+            _count_trace("dense_step_wide", f"{D}x{K}")
             state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
@@ -348,6 +361,7 @@ class TpuDocumentApplier:
 
     @staticmethod
     def _local_step(state: DocState, ops: jax.Array):
+        _count_trace("local_step", "x".join(map(str, ops.shape[:2])))
         state = apply_ops_batch(state, ops)
         state = compact_batch(state, wave_min_seq(ops))
         return state, {}

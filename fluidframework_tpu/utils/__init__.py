@@ -9,10 +9,18 @@ from .contracts import (  # noqa: F401
     registered_contracts,
 )
 from .telemetry import (  # noqa: F401
+    HOP_ACK,
+    HOP_ADMIT,
+    HOP_DELI,
+    HOP_FANOUT,
+    HOP_RELAY,
+    HOP_SUBMIT,
+    HOPS,
     BufferSink,
     Counters,
     PerformanceEvent,
     TelemetryLogger,
     TraceAggregator,
+    hop_pair_name,
     percentile,
 )

@@ -22,6 +22,51 @@ from typing import Any, Callable, Optional
 
 Sink = Callable[[dict], None]
 
+# --------------------------------------------------------------- hop taxonomy
+#
+# The per-tier trace-hop vocabulary, in pipeline order. Columnar wire
+# frames carry hops as compact (hop id, timestamp) pairs (the binwire
+# hoptail); rec frames carry the (service, action) strings. Both sides
+# map through THIS table — it is the taxonomy's single source of truth
+# — and the breakdown pair names (``submit_to_deli``, ``deli_to_ack``,
+# ``admit_to_deli``, …) derive from the SHORT keys of consecutive
+# PRESENT hops, so the legacy two-pair split falls out as the special
+# case where only client/submit and deli/sequence are stamped.
+HOPS = (
+    ("client", "submit", "submit"),
+    ("gateway", "relay", "relay"),
+    ("frontend", "admit", "admit"),
+    ("deli", "sequence", "deli"),
+    ("broadcast", "fanout", "fanout"),
+    ("client", "ack", "ack"),
+)
+(HOP_SUBMIT, HOP_RELAY, HOP_ADMIT, HOP_DELI, HOP_FANOUT,
+ HOP_ACK) = range(len(HOPS))
+#: hop id → (service, action) — the rec-frame string pair.
+HOP_SERVICE_ACTION = tuple((s, a) for s, a, _ in HOPS)
+#: (service, action) → hop id.
+HOP_ID = {(s, a): i for i, (s, a, _) in enumerate(HOPS)}
+#: hop id → short key used in breakdown pair names.
+HOP_SHORT = tuple(short for _, _, short in HOPS)
+
+
+def hop_pair_name(a: int, b: int) -> str:
+    """The breakdown key for the leg between two hop ids."""
+    return f"{HOP_SHORT[a]}_to_{HOP_SHORT[b]}"
+
+
+def hop_pairs(hops) -> list[tuple[str, float]]:
+    """[(hop_id, ts), ...] → [(pair_name, delta_ms), ...] between
+    consecutive PRESENT hops in taxonomy order (unknown ids ignored;
+    a repeated id keeps its last timestamp)."""
+    ts_by_id: dict[int, float] = {}
+    for i, ts in hops:
+        if 0 <= i < len(HOPS):
+            ts_by_id[i] = ts
+    order = sorted(ts_by_id)
+    return [(hop_pair_name(a, b), (ts_by_id[b] - ts_by_id[a]) * 1e3)
+            for a, b in zip(order, order[1:])]
+
 
 def percentile(sorted_vals: list[float], p: float) -> float:
     if not sorted_vals:
@@ -161,30 +206,44 @@ class Counters:
 
 
 class TraceAggregator:
-    """Consume wire trace hops into a per-hop latency breakdown.
+    """Consume wire trace hops into an ordered hop-pair breakdown.
 
-    The submitting client stamps ``client/submit``; deli stamps
-    ``deli/sequence`` (service/deli.py); the ack observer calls
-    ``record(msg)`` when its own op comes back. Produces the
-    submit→deli and deli→ack split the north-star p99 decomposes into.
+    Each tier stamps its hop from the :data:`HOPS` taxonomy (client/
+    submit, gateway/relay, frontend/admit, deli/sequence, broadcast/
+    fanout); the ack observer calls ``record(msg)`` when its own op
+    comes back. Every leg between consecutive PRESENT hops becomes a
+    ``{a}_to_{b}`` latency series — partial stamping (only client+deli)
+    reproduces the legacy submit→deli / deli→ack split exactly.
     """
 
     def __init__(self):
         self._hops: dict[str, list[float]] = defaultdict(list)
 
     def record(self, msg, ack_time: Optional[float] = None) -> None:
-        now = ack_time if ack_time is not None else time.time()
-        submit_ts = None
-        deli_ts = None
+        hops = []
         for hop in msg.traces:
-            if hop.service == "client" and hop.action == "submit":
-                submit_ts = hop.timestamp
-            elif hop.service == "deli" and hop.action == "sequence":
-                deli_ts = hop.timestamp
-        if submit_ts is not None and deli_ts is not None:
-            self._hops["submit_to_deli"].append((deli_ts - submit_ts) * 1e3)
-        if deli_ts is not None:
-            self._hops["deli_to_ack"].append((now - deli_ts) * 1e3)
+            i = HOP_ID.get((hop.service, hop.action))
+            if i is not None:
+                hops.append((i, hop.timestamp))
+        self.record_hops(
+            hops, ack_time if ack_time is not None else time.time())
+
+    def record_hops(self, hops, ack_time: Optional[float] = None) -> None:
+        """Fold an ordered [(hop_id, timestamp), ...] list (the wire
+        hoptail shape) into the breakdown.
+
+        ``ack_time`` contributes the client/ack hop — but only when the
+        op was actually sequenced (a deli-or-later hop is present): an
+        op that never reached the sequencer has no ack latency to
+        attribute, so a lone client/submit stamp records nothing.
+        """
+        known = [(i, ts) for i, ts in hops if 0 <= i < len(HOPS)]
+        if (ack_time is not None
+                and all(i != HOP_ACK for i, _ in known)
+                and any(i >= HOP_DELI for i, _ in known)):
+            known.append((HOP_ACK, ack_time))
+        for name, ms in hop_pairs(known):
+            self._hops[name].append(ms)
 
     def merge_raw(self, hops: dict[str, list[float]]) -> None:
         for name, vals in hops.items():

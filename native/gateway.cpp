@@ -41,6 +41,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -852,16 +853,36 @@ void handle_client_frame(Gateway* g, Session* s, const char* body,
     if ((ft == kFtSubmit || ft == kFtColsSubmit) && s->sid != 0) {
       // splice: 01 01 <batch> -> 01 03 u32sid <batch>; the columnar
       // twin is the identical rewrite (01 05 -> 01 06 u32sid)
+      uint8_t hoptail_k =
+          (ft == kFtColsSubmit && len >= 3) ? (uint8_t)body[len - 1] : 0;
+      // sampled columnar frame (hoptail count > 0): splice the
+      // gateway/relay hop before the trailing count byte — unsampled
+      // frames cost one byte read, same as the Python gateway
+      bool stamp = hoptail_k > 0 && hoptail_k < 0xFF;
       std::string out;
-      out.reserve(len + 8 + 4);
-      frame_header(&out, len + 4);
+      out.reserve(len + 8 + 4 + (stamp ? 9 : 0));
+      frame_header(&out, len + 4 + (stamp ? 9 : 0));
       out.push_back((char)kMagic);
       out.push_back((char)(ft == kFtSubmit ? kFtFsubmit : kFtColsFsubmit));
       out.push_back((char)((s->sid >> 24) & 0xFF));
       out.push_back((char)((s->sid >> 16) & 0xFF));
       out.push_back((char)((s->sid >> 8) & 0xFF));
       out.push_back((char)(s->sid & 0xFF));
-      out.append(body + 2, len - 2);
+      if (stamp) {
+        out.append(body + 2, len - 3);  // content minus count byte
+        out.push_back((char)1);         // hop id: gateway/relay
+        struct timespec now_ts;
+        clock_gettime(CLOCK_REALTIME, &now_ts);
+        double now =
+            (double)now_ts.tv_sec + (double)now_ts.tv_nsec * 1e-9;
+        uint64_t bits;
+        std::memcpy(&bits, &now, sizeof(bits));
+        for (int i = 7; i >= 0; --i)
+          out.push_back((char)((bits >> (8 * i)) & 0xFF));
+        out.push_back((char)(hoptail_k + 1));
+      } else {
+        out.append(body + 2, len - 2);
+      }
       send_upstream(g, std::move(out));
     } else {
       send_error(g, s, "", "unexpected binary frame");

@@ -5,6 +5,8 @@ The quick campaigns here run in-process (phase A) and stay in the tier-1
 set; the multi-seed and socket (phase B) soaks are marked ``slow``.
 """
 
+import json
+
 import pytest
 
 from fluidframework_tpu.chaos import (
@@ -287,7 +289,15 @@ def test_soak_quick_phase_a_holds_invariants():
     out = run_soak(seed=0, quick=True, phases="a")
     assert out["observed"] > 10
     assert out["coverage"]  # at least one boundary class hit
-    assert out["counters"]["chaos.injected"] >= 5
+    assert out["counters"]["chaos.faults.injected"] >= 5
+    # the injected orderer crash must have dumped the flight recorder,
+    # and the dump's tail must carry pre-crash telemetry
+    assert out["flight_dump"] is not None
+    with open(out["flight_dump"], encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    assert json.loads(lines[0])["flight"] == "orderer_crash"
+    kinds = {json.loads(ln).get("kind") for ln in lines[1:]}
+    assert "event" in kinds
 
 
 def test_soak_fails_when_monitor_dedupe_broken():

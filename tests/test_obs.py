@@ -1,0 +1,298 @@
+"""Observability plane: hoptail codec, labeled metrics registry,
+flight recorder, and the hop-trace path end to end (rec vs columnar).
+
+Ref: services/src/metricClient.ts (labeled series), protocol ITrace
+hops; the wire trailer and registry are ours (ARCHITECTURE.md
+"Observability").
+"""
+
+import json
+import random
+import socket
+import struct
+import time
+
+import pytest
+
+from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+from fluidframework_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus,
+)
+from fluidframework_tpu.protocol import binwire
+from fluidframework_tpu.protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    TraceHop,
+)
+from fluidframework_tpu.service.front_end import NetworkFrontEnd
+from fluidframework_tpu.service.local_server import LocalServer
+from fluidframework_tpu.utils.telemetry import (
+    HOP_ADMIT,
+    HOP_DELI,
+    HOP_FANOUT,
+    HOP_SUBMIT,
+    TraceAggregator,
+    hop_pairs,
+)
+from tests.test_columnar import _rand_cols_ops
+
+
+def wait_for(pred, timeout: float = 20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+# --------------------------------------------------------------- hoptail
+
+
+def test_hoptail_append_and_read_roundtrip():
+    """append_hop splices entries without parsing frame content, and
+    read_hoptail returns them in stamp order with f64 bits intact."""
+    body = binwire.encode_submit_columns(_rand_cols_ops(random.Random(1), 3))
+    assert body[-1] == 0                    # unsampled: single NUL count
+    assert binwire.read_hoptail(body) == []
+    t0, t1 = 1754400000.125, 1754400000.875  # exactly representable
+    stamped = binwire.append_hop(body, HOP_SUBMIT, t0)
+    stamped = binwire.append_hop(stamped, HOP_ADMIT, t1)
+    assert binwire.read_hoptail(stamped) == [(HOP_SUBMIT, t0),
+                                             (HOP_ADMIT, t1)]
+    # the original content bytes precede the tail unmodified
+    assert stamped[:len(body) - 1] == body[:-1]
+    # strict mode: the declared content end must account for the tail
+    end = len(body) - 1
+    assert binwire.read_hoptail(stamped, end=end) == [(HOP_SUBMIT, t0),
+                                                      (HOP_ADMIT, t1)]
+    assert binwire.read_hoptail(stamped, end=end - 1) == []
+    # lenient mode on an inconsistent tail (count byte larger than the
+    # frame) yields [] rather than raising — durable-replay safety
+    assert binwire.read_hoptail(b"\x01\xff") == []
+    assert binwire.read_hoptail(b"") == []
+
+
+def test_hoptail_full_tail_drops_stamp_not_frame():
+    body = binwire.encode_submit_columns(_rand_cols_ops(random.Random(2), 2))
+    for i in range(0xFF):
+        body = binwire.append_hop(body, HOP_SUBMIT, float(i))
+    assert body[-1] == 0xFF
+    assert binwire.append_hop(body, HOP_ADMIT, 1.0) == body  # capped
+    assert len(binwire.read_hoptail(body)) == 0xFF
+
+
+# -------------------------------------------------------------- registry
+
+
+def test_registry_labels_and_prometheus_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("net.ingress.frames", 3, tier="frontend")
+    reg.inc("net.ingress.frames", 2, tier="gateway")
+    reg.set_gauge("deli.queue.depth", 7, doc="d1")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        reg.observe("obs.hop.ms", v, pair="submit_to_admit")
+    series = parse_prometheus(reg.scrape())
+    frames = series["fluid_net_ingress_frames"]
+    assert frames[(("tier", "frontend"),)] == 3
+    assert frames[(("tier", "gateway"),)] == 2
+    assert series["fluid_deli_queue_depth"][(("doc", "d1"),)] == 7
+    cnt = series["fluid_obs_hop_ms_count"]
+    assert cnt[(("pair", "submit_to_admit"),)] == 4
+    assert series["fluid_obs_hop_ms_sum"][(("pair", "submit_to_admit"),)] \
+        == 10.0
+    assert series["fluid_obs_series_dropped"][()] == 0
+    with pytest.raises(ValueError):
+        parse_prometheus("not a metric line at all")
+
+
+def test_registry_cardinality_is_bounded():
+    """Past max_series distinct label sets, samples land in ONE overflow
+    bucket and the spill is counted — a hostile label stream cannot grow
+    the scrape without bound."""
+    reg = MetricsRegistry(max_series=4)
+    for i in range(10):
+        reg.inc("front.conns.opened", tenant=f"t{i}")
+    series = parse_prometheus(reg.scrape())
+    conns = series["fluid_front_conns_opened"]
+    assert len(conns) == 5  # 4 real label sets + the overflow bucket
+    assert conns[(("overflow", "true"),)] == 6
+    assert series["fluid_obs_series_dropped"][()] == 6
+
+
+def test_tier_counters_aggregate_into_scrape():
+    """Hot-path Counters instances registered under a tier label keep
+    their lock-free writes; the scrape sums them per (name, tier)."""
+    reg = MetricsRegistry()
+    from fluidframework_tpu.utils.telemetry import Counters
+
+    a, b = Counters(), Counters()
+    reg.register_tier("deli", a)
+    reg.register_tier("deli", b)
+    a.inc("deli.boxcars.ticketed", 5)
+    b.inc("deli.boxcars.ticketed", 7)
+    a.observe("deli.ticket.ms", 2.0)
+    series = parse_prometheus(reg.scrape())
+    assert series["fluid_deli_boxcars_ticketed"][(("tier", "deli"),)] == 12
+    assert series["fluid_deli_ticket_ms_count"][(("tier", "deli"),)] == 1
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_rings_and_dump(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path), event_ring=8,
+                         frame_ring=4, max_conns=2)
+    for i in range(20):
+        rec.event("deli", "ticket", seq=i)
+    for i in range(10):
+        rec.frame("conn-a", "in", b"\x01\x05" + bytes([i]) * 20)
+    rec.frame("conn-b", "out", b"\x01\x07")
+    rec.frame("conn-c", "in", b"\x01\x05")  # evicts oldest-touched conn-a
+    path = rec.dump("unit_test", detail="why")
+    assert rec.last_dump == path
+    lines = [json.loads(x) for x in open(path, encoding="utf-8")]
+    header, rest = lines[0], lines[1:]
+    assert header["flight"] == "unit_test" and header["detail"] == "why"
+    events = [x for x in rest if x["kind"] == "event"]
+    frames = [x for x in rest if x["kind"] == "frame"]
+    assert [e["seq"] for e in events] == list(range(12, 20))  # ring of 8
+    conns = {f["conn"] for f in frames}
+    assert conns == {"conn-b", "conn-c"}  # conn-a LRU-evicted
+    assert all(len(f["head"]) <= 24 for f in frames)  # digests, not bodies
+    # a second dump gets its own file
+    assert rec.dump("again") != path
+
+
+# ----------------------------------------------- hop path, rec vs cols
+
+
+@pytest.fixture
+def front_end():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    yield fe
+    fe.stop()
+
+
+def _frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+def _bin_client(port: int, doc: str):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(_frame({"t": "connect", "tenant": "t", "doc": doc,
+                      "rid": 1, "bin": 1}))
+    buf = [b""]
+
+    def read_frame():
+        while True:
+            b = buf[0]
+            if len(b) >= 4:
+                n = int.from_bytes(b[:4], "big")
+                if len(b) >= 4 + n:
+                    buf[0] = b[4 + n:]
+                    return b[4:4 + n]
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed")
+            buf[0] += chunk
+    while binwire.is_binary(read_frame()):
+        pass  # drain the JSON connect reply
+    return s, read_frame
+
+
+def test_sampled_hops_survive_fanout_cache_verbatim(front_end):
+    """A sampled columnar submit's client stamp reaches every subscriber
+    BIT-IDENTICAL in the broadcast hoptail, with admit/deli/fanout
+    appended in order — and the second subscriber is served the same
+    bytes from the encode-once cache (satellite c1)."""
+    ops = _rand_cols_ops(random.Random(27), 6)
+    body = binwire.encode_submit_columns(ops)
+    t_submit = time.time()
+    body = binwire.append_hop(body, HOP_SUBMIT, t_submit)
+
+    s1, read1 = _bin_client(front_end.port, "doc-hops")
+    s2, read2 = _bin_client(front_end.port, "doc-hops")
+    s1.sendall(binwire.frame(body))
+
+    def next_cols(read):
+        while True:
+            f = read()
+            if binwire.is_binary(f) and f[1] in (binwire.FT_COLS_OPS,
+                                                 binwire.FT_COLS_FOPS):
+                return f
+
+    b1, b2 = next_cols(read1), next_cols(read2)
+    assert b1 == b2  # encode-once fan-out: identical bytes
+    # the client's stamp survives as its exact 9 wire bytes
+    assert struct.pack(">Bd", HOP_SUBMIT, t_submit) in b1
+    hops = binwire.read_hoptail(b1)
+    assert [h for h, _ in hops] == [HOP_SUBMIT, HOP_ADMIT, HOP_DELI,
+                                    HOP_FANOUT]
+    assert hops[0][1] == t_submit  # verbatim through splice + cache
+    ts = [t for _, t in hops]
+    assert ts == sorted(ts)
+    # egress observed every consecutive pair into the process registry
+    series = parse_prometheus(get_registry().scrape())
+    pairs = {dict(k).get("pair")
+             for k in series.get("fluid_obs_hop_ms_count", {})}
+    assert {"submit_to_admit", "admit_to_deli",
+            "deli_to_fanout"} <= pairs
+    snap = front_end.counters.snapshot
+    assert wait_for(lambda: snap().get("net.fanout.cache_hits", 0) >= 1)
+    s1.close()
+    s2.close()
+
+
+def test_aggregator_breakdown_identical_rec_vs_cols(front_end):
+    """The SAME logical traffic traced through the rec path (per-op
+    TraceHop records) and the columnar path (frame hoptail) must yield
+    the same hop-pair breakdown from TraceAggregator (satellite c2)."""
+    # --- rec path: a non-columnable op with an explicit client stamp
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    conn = factory.create_document_service(
+        "t", "doc-rec").connect_to_delta_stream()
+    acked = []
+    conn.on_op = lambda m: (m.client_id == conn.client_id
+                            and acked.append(m))
+    conn.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"free": "form"},
+        traces=[TraceHop("client", "submit", time.time())])])
+    assert wait_for(lambda: acked)
+    agg_rec = TraceAggregator()
+    agg_rec.record(acked[0], ack_time=time.time())
+    conn.close()
+
+    # --- cols path: a sampled columnar boxcar over the binary wire
+    body = binwire.encode_submit_columns(_rand_cols_ops(random.Random(3), 4))
+    body = binwire.append_hop(body, HOP_SUBMIT, time.time())
+    s, read = _bin_client(front_end.port, "doc-cols2")
+    s.sendall(binwire.frame(body))
+    while True:
+        f = read()
+        if binwire.is_binary(f) and f[1] in (binwire.FT_COLS_OPS,
+                                             binwire.FT_COLS_FOPS):
+            break
+    s.close()
+    agg_cols = TraceAggregator()
+    agg_cols.record_hops(binwire.read_hoptail(f), ack_time=time.time())
+
+    rep_rec, rep_cols = agg_rec.report(), agg_cols.report()
+    assert set(rep_rec) == set(rep_cols) == {
+        "submit_to_admit", "admit_to_deli", "deli_to_fanout",
+        "fanout_to_ack"}
+    assert all(rep_rec[k]["count"] == rep_cols[k]["count"] == 1
+               for k in rep_rec)
+
+
+def test_hop_pairs_keeps_last_ts_on_repeat():
+    """A repeated hop id (retried relay) keeps the LAST stamp so legs
+    stay non-overlapping."""
+    pairs = dict(hop_pairs([(HOP_SUBMIT, 1.0), (HOP_SUBMIT, 2.0),
+                            (HOP_DELI, 5.0)]))
+    assert pairs == {"submit_to_deli": 3000.0}

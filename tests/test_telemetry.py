@@ -157,7 +157,8 @@ def test_trace_aggregator_merge_raw_and_percentiles():
 
 def test_deli_stamps_ride_to_clients_and_aggregate():
     """End-to-end: submit through the real pipeline; the broadcast op
-    carries client+deli hops and the aggregator splits the latency."""
+    carries client+deli+fanout hops and the aggregator splits the
+    latency into every stamped leg."""
     from fluidframework_tpu.protocol.messages import DocumentMessage
     from fluidframework_tpu.service import LocalServer
 
@@ -176,7 +177,8 @@ def test_deli_stamps_ride_to_clients_and_aggregate():
     assert acked
     rep = agg.report()
     assert rep["submit_to_deli"]["count"] == 1
-    assert rep["deli_to_ack"]["count"] == 1
+    assert rep["deli_to_fanout"]["count"] == 1
+    assert rep["fanout_to_ack"]["count"] == 1
 
 
 def test_deli_nacks_and_evictions_are_logged():

@@ -9,11 +9,11 @@ import argparse
 import os
 import sys
 
-PASSES = ("layers", "jaxpr", "wire", "hygiene")
+PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name")
 
 
 def run(passes, repo_root: str) -> list:
-    from . import hygiene, jaxpr_check, layers, wire_check
+    from . import hygiene, jaxpr_check, layers, metrics_check, wire_check
 
     violations = []
     if "layers" in passes:
@@ -26,6 +26,8 @@ def run(passes, repo_root: str) -> list:
         violations += wire_check.check_wire(repo_root=repo_root)
     if "hygiene" in passes:
         violations += hygiene.check_hygiene(repo_root=repo_root)
+    if "metric-name" in passes:
+        violations += metrics_check.check_metrics(repo_root=repo_root)
     return violations
 
 

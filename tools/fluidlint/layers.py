@@ -43,6 +43,9 @@ PACKAGE = "fluidframework_tpu"
 #: table, and PACKAGES.md is generated from it.
 ALLOWED = {
     "utils": set(),
+    # the observability plane sits just above utils: registry + flight
+    # recorder; any tier may report INTO it, it imports nothing back
+    "obs": {"utils"},
     "protocol": {"utils"},
     "mergetree": {"protocol", "utils"},
     "ops": {"mergetree", "protocol", "utils"},
@@ -54,13 +57,13 @@ ALLOWED = {
     # drivers bind the loader contracts to a service; the local driver
     # reaches into service (the reference's local-driver does the same —
     # localDocumentService.ts binds straight to LocalDeltaConnectionServer)
-    "driver": {"protocol", "utils", "service", "mergetree"},
+    "driver": {"protocol", "utils", "service", "mergetree", "obs"},
     "framework": {"loader", "runtime", "dds", "mergetree", "protocol",
                   "utils"},
     # the service branch: protocol + utils + the TPU kernel stack; the
     # wire helpers live in driver (shared transport), NEVER runtime/loader
     "service": {"protocol", "utils", "ops", "parallel", "mergetree",
-                "driver", "native"},
+                "driver", "native", "obs"},
     "native": {"utils"},
     "replay": {"loader", "driver", "runtime", "dds", "protocol", "utils",
                "service", "mergetree"},
@@ -69,12 +72,15 @@ ALLOWED = {
     # production layer may import chaos back — the seams stay duck-typed
     # (`fault_plane = None` class attrs / module hooks), so disarmed code
     # has no chaos dependency at all; only tests and the soak import it
-    "chaos": {"service", "driver", "mergetree", "protocol", "utils"},
+    "chaos": {"service", "driver", "mergetree", "protocol", "utils",
+              "obs"},
 }
 
 #: One-line role per layer, used by the PACKAGES.md generator.
 LAYER_DOC = {
     "utils": "base utils: telemetry, metrics, kernel-contract registry",
+    "obs": "observability: labeled metrics registry, Prometheus scrape, "
+           "flight recorder",
     "protocol": "wire messages, consensus kernel, binary codec",
     "mergetree": "scalar merge-tree CRDT (the readable oracle)",
     "ops": "TPU device kernels: batched apply, doc state, Pallas path",

@@ -56,6 +56,7 @@ def main() -> int:
     from fluidframework_tpu.driver.network import (
         NetworkDocumentServiceFactory,
     )
+    from fluidframework_tpu.obs import parse_prometheus
     from fluidframework_tpu.protocol.messages import (
         DocumentMessage,
         MessageType,
@@ -90,6 +91,9 @@ def main() -> int:
     # force the window on (the adaptive tuner would keep an idle client
     # inline): the smoke asserts the MECHANISM, not the tuner
     conn1.coalesce_window = 0.002
+    # arm tracing on every boxcar: the scrape gate below requires each
+    # hop leg of the in-proc topology to have counted at least once
+    conn1.trace_sample_n = 1
     conn2 = factory.create_document_service(
         "smoke", "doc").connect_to_delta_stream()
     seen1: list = []
@@ -157,6 +161,26 @@ def main() -> int:
     while reply.get("t") != "pong":
         reply = read_frame()
 
+    # labeled metrics scrape: must come back as parseable Prometheus
+    # text, and every hop leg of the in-proc topology (no gateway, so
+    # no relay) must have a non-zero observation count
+    s.sendall(_frame({"t": "admin_metrics_scrape", "rid": 2}))
+    reply = read_frame()
+    while reply.get("rid") != 2:
+        reply = read_frame()
+    try:
+        series = parse_prometheus(reply["scrape"])
+    except ValueError as e:
+        print(f"net_smoke: FAIL — scrape is not Prometheus text: {e}",
+              file=sys.stderr)
+        return 1
+    hop_counts = {
+        dict(k).get("pair"): v
+        for k, v in series.get("fluid_obs_hop_ms_count", {}).items()}
+    want_pairs = ("submit_to_admit", "admit_to_deli", "deli_to_fanout")
+    dead_pairs = sorted(p for p in want_pairs
+                        if hop_counts.get(p, 0) <= 0)
+
     drv = factory.counters.snapshot()
     srv = front.counters.snapshot()
     checks = {
@@ -177,12 +201,17 @@ def main() -> int:
     front.stop()
 
     print(json.dumps({"checks": checks,
+                      "hop_counts": hop_counts,
                       "driver.submit.frames": frames,
                       "driver.submit.ops": ops}, indent=2))
     dead = sorted(k for k, v in checks.items() if v == 0)
     if dead:
         print(f"net_smoke: FAIL — counters stayed at zero under load: "
               f"{dead}", file=sys.stderr)
+        return 1
+    if dead_pairs:
+        print(f"net_smoke: FAIL — hop pairs missing from the scrape: "
+              f"{dead_pairs} (got {sorted(hop_counts)})", file=sys.stderr)
         return 1
     if frames >= ops:
         print(f"net_smoke: FAIL — coalescing never reduced frame count "

@@ -144,6 +144,23 @@ def main() -> None:
     print(f"ceiling at this link = bw/bytes_per_op = "
           f"{bw/bpo:,.0f} ops/s")
 
+    # ---- recompiles: which kernels traced, how many times ----
+    # a kernel-number swing between runs (the r4→r5 note above) is only
+    # attributable if the recompile count is in the artifact: a second
+    # trace of the same kernel means the run paid compile time mid-trial
+    from fluidframework_tpu.obs import get_registry, parse_prometheus
+
+    series = parse_prometheus(get_registry().scrape())
+    recompiles = series.get("fluid_applier_kernel_recompiled", {})
+    print("recompiles:")
+    for key in sorted(recompiles):
+        labels = dict(key)
+        print(f"  {labels.get('kernel', '?'):16s} "
+              f"shape {labels.get('shape', '?'):12s} "
+              f"x{recompiles[key]:g}")
+    if not recompiles:
+        print("  (none recorded — kernels served from the jit cache)")
+
 
 if __name__ == "__main__":
     main()
