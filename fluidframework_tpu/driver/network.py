@@ -63,6 +63,20 @@ _COALESCE_MIN_ACK_S = 0.005
 _COALESCE_MAX_WINDOW_S = 0.004
 
 
+class LogTruncatedError(RuntimeError):
+    """The requested backfill range reaches below the server's retention
+    base: the prefix is summary-covered and gone from the op log — catch
+    up from the latest summary instead of retrying a range that can
+    never fill. (The driver's own class, mirroring the service-side
+    exception: drivers never import service modules.)"""
+
+    def __init__(self, base: int):
+        super().__init__(
+            f"op log truncated below seq {base}: reload from the latest "
+            "acked summary")
+        self.base = base
+
+
 class _Transport:
     """One framed TCP connection + reader thread + rid-matched requests."""
 
@@ -76,6 +90,11 @@ class _Transport:
         self._wlock = threading.Lock()
         self._rid = itertools.count(1)
         self._pending: dict[int, dict] = {}  # rid → reply frame
+        # rid → decoded backfill messages from FT_COLS_DELTAS pushes; the
+        # pushes and the terminal JSON reply ride the same wire and the
+        # same reader thread, so by the time the reply is matched every
+        # block for that rid has landed here
+        self._blocks: dict[int, list] = {}
         self._pending_cv = threading.Condition()
         self._push_handlers: dict[str, Callable[[dict], None]] = {}
         # binary ops batches bypass the dict layer entirely
@@ -145,6 +164,11 @@ class _Transport:
 
     def request(self, frame: dict) -> dict:
         """Send a frame with a request id; block for the matching reply."""
+        return self.request_rid(frame)[1]
+
+    def request_rid(self, frame: dict) -> tuple[int, dict]:
+        """Like :meth:`request` but also returns the rid, so callers can
+        collect rid-tagged binary pushes (:meth:`take_blocks`)."""
         rid = next(self._rid)
         self.send(dict(frame, rid=rid))
         with self._pending_cv:
@@ -152,13 +176,21 @@ class _Transport:
                 lambda: rid in self._pending or self._closed,
                 timeout=self.timeout)
             if not ok or rid not in self._pending:
+                self._blocks.pop(rid, None)
                 raise ConnectionError(
                     f"no reply for {frame.get('t')} (connection "
                     f"{'closed' if self._closed else 'timed out'})")
             reply = self._pending.pop(rid)
         if reply.get("t") == "error":
+            self._blocks.pop(rid, None)
+            if reply.get("code") == "log_truncated":
+                raise LogTruncatedError(int(reply.get("base", 0)))
             raise RuntimeError(f"server error: {reply.get('message')}")
-        return reply
+        return rid, reply
+
+    def take_blocks(self, rid: int) -> list:
+        """Claim the decoded backfill messages pushed for ``rid``."""
+        return self._blocks.pop(rid, [])
 
     # ------------------------------------------------------------ receiving
 
@@ -211,6 +243,14 @@ class _Transport:
                 if body is None:
                     break
                 if binwire.is_binary(body):
+                    if body[1] == binwire.FT_COLS_DELTAS:
+                        # rid-tagged backfill block: decode the column
+                        # section client-side and stage it for the
+                        # requester (the terminal JSON reply arrives
+                        # after, on this same thread)
+                        brid, msgs = binwire.read_cols_deltas(body)
+                        self._blocks.setdefault(brid, []).extend(msgs)
+                        continue
                     cb = self.on_binary_ops
                     if cb is not None:
                         _, msgs = binwire.decode_ops(body)
@@ -315,6 +355,9 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         self.initial_sequence_number = reply["seq"]
         self.mode = reply.get("mode", "write")
         self.max_message_size = reply.get("maxMessageSize")
+        # server advertises the columnar backfill door only on direct
+        # core connections (a gateway cannot relay the binary pushes)
+        self.cols_backfill = bool(reply.get("colsBackfill"))
 
     def _deliver(self, kind: str, event) -> None:
         if kind == "op" \
@@ -534,16 +577,37 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
 
 class NetworkDeltaStorage(DocumentDeltaStorage):
+    """``cols`` is a late-bound flag (callable or bool): whether the
+    server advertised the columnar backfill door on the delta-stream
+    connect (it may connect after this object is built)."""
+
     def __init__(self, transport: _Transport, tenant_id: str,
-                 document_id: str, token_provider=None):
+                 document_id: str, token_provider=None, cols=False):
         self._t = transport
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
+        self._cols = cols
 
     def get_deltas(self, from_seq: int, to_seq: int):
         token = (self._token_provider(self._tenant, self._doc)
                  if self._token_provider else None)
+        cols = self._cols() if callable(self._cols) else self._cols
+        if cols:
+            # columnar door: blocks arrive as rid-tagged binary pushes
+            # (already decoded into take_blocks by the reader thread); a
+            # boundary block may overhang the range, so trim by seq here
+            rid, reply = self._t.request_rid({
+                "t": "get_deltas_cols", "tenant": self._tenant,
+                "doc": self._doc, "from": from_seq, "to": to_seq,
+                "token": token})
+            msgs = [message_from_dict(d) for d in reply.get("msgs", [])]
+            blocks = self._t.take_blocks(rid)
+            if blocks:
+                msgs.extend(m for m in blocks
+                            if from_seq < m.sequence_number < to_seq)
+                msgs.sort(key=lambda m: m.sequence_number)
+            return msgs
         reply = self._t.request({
             "t": "get_deltas", "tenant": self._tenant, "doc": self._doc,
             "from": from_seq, "to": to_seq, "token": token})
@@ -649,6 +713,7 @@ class NetworkDocumentService(DocumentService):
         self.counters = (counters if counters is not None
                          else tier_counters("driver"))
         self._rpc: Optional[_Transport] = None
+        self._cols_backfill = False  # learned from the stream connect
 
     def _rpc_transport(self) -> _Transport:
         if self._rpc is None or self._rpc._closed:
@@ -659,14 +724,17 @@ class NetworkDocumentService(DocumentService):
         t = _Transport(self._host, self._port, self._timeout)
         token = (self._token_provider(self._tenant, self._doc)
                  if self._token_provider else None)
-        return NetworkDeltaConnection(t, self._tenant, self._doc, details,
+        conn = NetworkDeltaConnection(t, self._tenant, self._doc, details,
                                       token=token, binary=self._binary,
                                       cache=self._cache,
                                       counters=self.counters)
+        self._cols_backfill = conn.cols_backfill
+        return conn
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorage:
         return NetworkDeltaStorage(self._rpc_transport(), self._tenant,
-                                   self._doc, self._token_provider)
+                                   self._doc, self._token_provider,
+                                   cols=lambda: self._cols_backfill)
 
     def connect_to_storage(self) -> NetworkStorage:
         return NetworkStorage(self._rpc_transport(), self._tenant,

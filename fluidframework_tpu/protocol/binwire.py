@@ -85,6 +85,7 @@ FT_COLS_SUBMIT = 5
 FT_COLS_FSUBMIT = 6
 FT_COLS_OPS = 7
 FT_COLS_FOPS = 8
+FT_COLS_DELTAS = 9
 
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
@@ -942,6 +943,84 @@ def decode_cols_ops(body: bytes) -> tuple[Optional[str],
         # convention of stamping the final op of a sampled boxcar
         msgs[-1].traces = hops_to_traces(hops)
     return topic, msgs
+
+
+# ------------------------------------------------ durable segment blocks
+# The storage tier (service/segment_store.py) persists each sequenced
+# boxcar as ONE column block whose payload is, byte for byte, the
+# FT_COLS_OPS stamp section:
+#
+#     block := f64 boxcar_ts (LE)            -- submit-time client stamp
+#              u16 cid_len + cid
+#              i64 base_seq (LE)
+#              f64 deli_ts (LE)
+#              cols section (encode_cols)
+#              n x i64 msns (LE)
+#
+# so backfill serving is a byte slice — prepend the 2-byte header, append
+# the 1-byte unsampled hoptail, and a binary client receives the same
+# stamped column bytes the broadcast fan-out shipped, with zero re-encode.
+# The leading boxcar_ts is the only field outside the wire stamp (the
+# boxcar's own submit timestamp survives log round-trips); slicing it off
+# is the whole cost of serving.
+
+SEG_COLS = 1   # columnar block: payload as above
+SEG_JSON = 2   # legacy compat shim: payload is an opaque encoded record
+
+
+def encode_seg_block(cols: bytes, client_id: str, base_seq: int, msns,
+                     timestamp: float, boxcar_ts: float) -> bytes:
+    """Pack one sequenced boxcar as a durable SEG_COLS block payload."""
+    cid = client_id.encode()
+    return b"".join((
+        np.array([boxcar_ts], "<f8").tobytes(),
+        len(cid).to_bytes(2, "little"), cid,
+        int(base_seq).to_bytes(8, "little", signed=True),
+        np.array([timestamp], "<f8").tobytes(),
+        cols,
+        np.ascontiguousarray(msns, "<i8").tobytes(),
+    ))
+
+
+def read_seg_block(payload: bytes):
+    """Parse a SEG_COLS payload → (boxcar_ts, cid, base_seq, ts, sc,
+    msns); the storage-side recovery decode (one np.frombuffer per
+    column, no per-op unpacking)."""
+    boxcar_ts = float(np.frombuffer(payload, "<f8", 1, 0)[0])
+    off = 8
+    cl = int.from_bytes(payload[off:off + 2], "little")
+    off += 2
+    cid = payload[off:off + cl].decode()
+    off += cl
+    base_seq = int.from_bytes(payload[off:off + 8], "little", signed=True)
+    off += 8
+    ts = float(np.frombuffer(payload, "<f8", 1, off)[0])
+    off += 8
+    sc, off = _read_cols(payload, off)
+    msns = np.frombuffer(payload, "<i8", sc.n, off)
+    return boxcar_ts, cid, base_seq, ts, sc, msns
+
+
+def seg_block_wire_body(payload: bytes) -> bytes:
+    """SEG_COLS payload → a complete FT_COLS_OPS body (unsampled
+    hoptail): the zero-re-encode backfill serving slice."""
+    return bytes((MAGIC, FT_COLS_OPS)) + payload[8:] + b"\x00"
+
+
+def cols_deltas_body(rid: int, payload: bytes) -> bytes:
+    """SEG_COLS payload → one FT_COLS_DELTAS backfill push body, tagged
+    with the u32 request id so the client routes it to the right
+    get_deltas_cols call. No hoptail: backfill is replay, not live."""
+    return (bytes((MAGIC, FT_COLS_DELTAS)) + rid.to_bytes(4, "big")
+            + payload[8:])
+
+
+def read_cols_deltas(body: bytes):
+    """FT_COLS_DELTAS body → (rid, sequenced messages)."""
+    rid = int.from_bytes(body[2:6], "big")
+    _, msgs = decode_cols_ops(bytes((MAGIC, FT_COLS_OPS)) + body[6:]
+                              + b"\x00")
+    return rid, msgs
 
 
 # --------------------------------------------------- gateway byte rewrites

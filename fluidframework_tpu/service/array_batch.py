@@ -135,6 +135,10 @@ class SequencedArrayBatch:
     def last_seq(self) -> int:
         return self.base_seq + self.n - 1
 
+    @property
+    def last_msn(self) -> int:
+        return int(self.msns[-1])
+
     def message(self, i: int) -> SequencedDocumentMessage:
         if self._materialized is not None:
             return self._materialized[i]

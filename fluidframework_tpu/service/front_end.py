@@ -14,6 +14,9 @@ Frame = 4-byte big-endian length + JSON body {"t": <type>, ...}:
     submit         {ops: [DocumentMessage…]}          (fire-and-forget, like socket submitOp)
     signal         {content, type}
     get_deltas     {tenant, doc, from, to, rid}       → deltas {msgs, rid}
+    get_deltas_cols {tenant, doc, from, to, rid}      → K × binary FT_COLS_DELTAS pushes,
+                                                        then deltas {msgs, blocks, head, rid}
+                                                        (direct core connections only)
     get_versions   {tenant, doc, count, rid}          → versions {versions, rid}
     get_tree       {tenant, doc, version, rid}        → tree {tree, rid}
     read_blob      {tenant, doc, id, rid}             → blob {hex, rid}
@@ -64,6 +67,7 @@ from ..protocol.serialization import message_from_dict, message_to_dict
 from ..utils.telemetry import HOP_ADMIT, HOP_SERVICE_ACTION, hop_pairs
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
+from .scriptorium import LogTruncatedError
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
 DEFAULT_MAX_MESSAGE_SIZE = 16 * 1024  # per-op cap, nacked (ref :96)
@@ -339,6 +343,12 @@ class _ClientSession:
                     "seq": conn.initial_sequence_number,
                     "mode": getattr(conn, "mode", "write"),
                     "maxMessageSize": self.front.max_message_size,
+                    # columnar backfill door (get_deltas_cols) — only on
+                    # DIRECT core connections: the gateway relays rid
+                    # replies as JSON and cannot route the binary
+                    # FT_COLS_DELTAS pushes, so its own connected reply
+                    # never advertises it
+                    "colsBackfill": True,
                 })
             elif t == "submit":
                 if self.conn is None:
@@ -367,6 +377,32 @@ class _ClientSession:
                     frame["tenant"], frame["doc"], frame["from"], frame["to"])
                 self.push("deltas", {
                     "rid": rid, "msgs": [message_to_dict(m) for m in msgs]})
+            elif t == "get_deltas_cols":
+                # columnar backfill: the in-range segment blocks push as
+                # raw FT_COLS_DELTAS bodies (stamped column bytes straight
+                # off the storage mmap — zero re-encode), then ONE JSON
+                # terminal carrying any compat-shim ops and the block
+                # count so the client knows the pushes all arrived (same
+                # wire, same thread: ordering is guaranteed)
+                self._check_rpc_auth(frame, write=False)
+                server = self.front.server_for(frame["tenant"], frame["doc"])
+                res = server.get_delta_blocks(
+                    frame["tenant"], frame["doc"], frame["from"], frame["to"])
+                if res is None:  # no segment stream: scalar fallback
+                    msgs = server.get_deltas(
+                        frame["tenant"], frame["doc"],
+                        frame["from"], frame["to"])
+                    self.push("deltas", {
+                        "rid": rid, "blocks": 0,
+                        "msgs": [message_to_dict(m) for m in msgs]})
+                else:
+                    payloads, legacy, head = res
+                    for p in payloads:
+                        self.push_raw(binwire.frame(
+                            binwire.cols_deltas_body(int(rid), p)))
+                    self.push("deltas", {
+                        "rid": rid, "blocks": len(payloads), "head": head,
+                        "msgs": [message_to_dict(m) for m in legacy]})
             elif t in ("get_versions", "get_tree", "read_blob",
                        "write_blob", "upload_summary"):
                 self._check_rpc_auth(
@@ -387,7 +423,14 @@ class _ClientSession:
         except Exception as e:  # noqa: BLE001 — report, don't kill the loop
             self.front.logger.error("frame_error", frame_type=t,
                                     message=str(e))
-            self.push("error", {"rid": rid, "message": str(e)})
+            err = {"rid": rid, "message": str(e)}
+            if isinstance(e, LogTruncatedError):
+                # machine-readable: the driver maps this to its own
+                # too-far-behind exception and switches to summary
+                # catch-up instead of retrying a range that can never fill
+                err["code"] = "log_truncated"
+                err["base"] = e.base
+            self.push("error", err)
 
     def handle_binary(self, body: bytes) -> None:
         """Dispatch a binwire frame: the hot submit path (direct and

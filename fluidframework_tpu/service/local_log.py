@@ -48,6 +48,15 @@ class OrderedLogBase:
     def _stored_length(self, topic: str) -> int:
         raise NotImplementedError
 
+    def _torn_append(self, topic: str, value: Any) -> int:
+        """Chaos-plane torn-write semantics: the write never reached the
+        medium (power cut mid append) — the producer believes it wrote,
+        consumers never see it; recovery is the client resubmit path.
+        Storage backends with a physical torn-tail representation
+        (DurableLog's segment streams) override this to actually leave
+        ragged bytes on disk and exercise the recovery scan."""
+        return self._stored_length(topic)
+
     # ----------------------------------------------------------- topic api
 
     def create_topic(self, topic: str) -> None:
@@ -61,12 +70,8 @@ class OrderedLogBase:
             directive = self.fault_plane("log.append", topic=topic,
                                          record=value)
             if directive == "torn":
-                # the write never reached the medium (power cut mid
-                # append; the native log truncates the torn tail on
-                # open) — the producer believes it wrote, consumers
-                # never see it; recovery is the client resubmit path
                 self._dirty[topic] = None
-                return self._stored_length(topic)
+                return self._torn_append(topic, value)
             if directive == "dup":
                 # the record lands twice (producer retry after a lost
                 # ack) — consumers must dedupe (deli by clientSeq,

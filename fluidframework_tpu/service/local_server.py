@@ -350,6 +350,35 @@ class LocalServer:
         return orderer.scriptorium.get_deltas(
             tenant_id, document_id, from_seq, to_seq)
 
+    def get_delta_blocks(
+        self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
+    ):
+        """Columnar backfill door: ``(payloads, msgs, head)`` covering
+        from_seq < seq < to_seq, or None when the durable log has no
+        segment stream for this doc (caller falls back to
+        :meth:`get_deltas`). ``payloads`` are raw segment-block byte
+        ranges (a boundary block may span past the range — the CLIENT
+        trims by seq); ``msgs`` are legacy-record ops materialized
+        through the compat shim. Enforces the same retention contract as
+        the scalar door: reaching below the trim base raises
+        :class:`~.scriptorium.LogTruncatedError` rather than silently
+        serving a partial range."""
+        from .scriptorium import LogTruncatedError
+
+        blocks = getattr(self.log, "delta_blocks", None)
+        if blocks is None:
+            return None
+        orderer = self._get_orderer(tenant_id, document_id)
+        base = orderer.scriptorium.retained_base(tenant_id, document_id)
+        if from_seq < base:
+            raise LogTruncatedError(base)
+        res = blocks(f"deltas/{tenant_id}/{document_id}", from_seq, to_seq)
+        if res is None:
+            return None
+        payloads, legacy = res
+        head = orderer.scriptorium.head_seq(tenant_id, document_id)
+        return payloads, legacy, head
+
     def drain(self) -> int:
         """Deliver all queued messages through the pipeline to quiescence."""
         return self.log.drain()

@@ -71,7 +71,7 @@ class ScribeLambda:
         if abatch is not None:
             # array-lane run: plain operations by construction
             self.protocol.observe_operation_run(
-                abatch.base_seq, abatch.last_seq, int(abatch.msns[-1]))
+                abatch.base_seq, abatch.last_seq, abatch.last_msn)
             return
         batch = message.value.get("boxcar")
         if batch is not None:

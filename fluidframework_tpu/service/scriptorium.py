@@ -113,6 +113,11 @@ class ScriptoriumLambda:
         return self._doc(self.collection(tenant_id, document_id)) \
             .get("base", 0)
 
+    def head_seq(self, tenant_id: str, document_id: str) -> int:
+        """Highest stored seq (== base on an empty/trimmed store)."""
+        doc = self._doc(self.collection(tenant_id, document_id))
+        return doc.get("base", 0) + len(doc["messages"])
+
     def get_deltas(
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
     ) -> list[SequencedDocumentMessage]:
@@ -131,11 +136,18 @@ class ScriptoriumLambda:
         if hi <= lo:
             return []
         out = []
-        for i in range(lo, hi):
+        i = lo
+        while i < hi:
             entry = log[i]
             if isinstance(entry, SequencedDocumentMessage):
                 out.append(entry)
-            else:  # a SequencedArrayBatch occupying its seq positions:
-                # materialize the one op this position holds (cold path)
-                out.append(entry.message(base + i + 1 - entry.base_seq))
+                i += 1
+                continue
+            # a SequencedArrayBatch occupies its n seq positions: slice
+            # ONE cached messages() list across the whole in-range run
+            # instead of materializing position by position
+            start = base + i + 1 - entry.base_seq
+            stop = min(entry.n, start + (hi - i))
+            out.extend(entry.messages()[start:stop])
+            i += stop - start
         return out

@@ -46,10 +46,49 @@ struct Topic {
     bool dirty = false;  // appended-to since the last flush/sync
 };
 
+// --------------------------------------------------------------- segments
+// Columnar segment streams (the Kafka segment+index trick): block bytes
+// are packed back to back into fixed-size segment files
+// <stream>.seg<k>, and a flat side index <stream>.segidx holds one
+// 32-byte entry per block:
+//
+//     entry := i64 first_seq, i64 last_seq,
+//              u32 seg, u32 off, u32 len, u32 btype   (little-endian)
+//
+// first/last_seq are the block's sequence-number span (non-decreasing
+// across entries — the deltas topic is appended in ticket order), so a
+// [from_seq, to_seq) backfill is a binary search over two sorted i64
+// columns plus raw byte-range reads. The Python side (service/
+// segment_store.py) mmaps the index + segment files and reads with one
+// np.frombuffer per file; this side owns appends, the segment roll, and
+// the torn-tail scan.
+
+struct SegEntry {
+    int64_t first_seq;
+    int64_t last_seq;
+    uint32_t seg;
+    uint32_t off;
+    uint32_t len;
+    uint32_t btype;
+};
+static_assert(sizeof(SegEntry) == 32, "segidx entry layout is on-disk ABI");
+
+struct SegStream {
+    FILE* index = nullptr;
+    FILE* data = nullptr;       // tail segment (writer only)
+    uint32_t cur_seg = 0;
+    uint64_t cur_off = 0;       // validated byte extent of the tail segment
+    std::vector<SegEntry> entries;
+    bool dirty = false;
+    bool torn = false;          // deliberate torn bytes past cur_off on disk
+};
+
 struct OpLog {
     std::string dir;
     std::map<std::string, Topic> topics;
+    std::map<std::string, SegStream> segs;
     std::mutex mu;
+    uint64_t seg_bytes = 4u << 20;  // segment roll threshold
     // consumer-process handles: never truncate (recovery is the single
     // writer's job — a reader truncating a live writer's ragged tail
     // would silently shift the writer's record ordinals)
@@ -145,6 +184,88 @@ Topic* get_topic(OpLog* log, const char* name) {
     return &res.first->second;
 }
 
+std::string seg_path(OpLog* log, const char* name, uint32_t seg) {
+    return log->dir + "/" + name + ".seg" + std::to_string(seg);
+}
+
+// physical size of segment file <name>.seg<k>, or 0 when absent
+uint64_t seg_file_size(OpLog* log, const char* name, uint32_t seg) {
+    FILE* f = fopen(seg_path(log, name, seg).c_str(), "rb");
+    if (!f) return 0;
+    fseek(f, 0, SEEK_END);
+    uint64_t n = (uint64_t)ftell(f);
+    fclose(f);
+    return n;
+}
+
+SegStream* get_seg(OpLog* log, const char* name) {
+    auto it = log->segs.find(name);
+    if (it != log->segs.end()) return &it->second;
+    if (!valid_topic_name(name)) return nullptr;
+
+    SegStream s;
+    std::string ipath = log->dir + "/" + name + ".segidx";
+    s.index = fopen(ipath.c_str(), log->readonly ? "rb" : "ab+");
+    if (!s.index) return nullptr;  // readonly: producer not there yet
+    fseek(s.index, 0, SEEK_SET);
+    SegEntry e;
+    while (fread(&e, sizeof(e), 1, s.index) == 1) s.entries.push_back(e);
+    fseek(s.index, 0, SEEK_END);
+    uint64_t index_bytes = (uint64_t)ftell(s.index);
+    // torn-tail scan, index side: cut a partial trailing entry (crash
+    // mid-index-write), then walk back entries whose block bytes never
+    // fully landed in the segment file (crash mid-block-write)
+    bool ragged = index_bytes != s.entries.size() * sizeof(SegEntry);
+    while (!s.entries.empty()) {
+        const SegEntry& last = s.entries.back();
+        if ((uint64_t)last.off + last.len <=
+            seg_file_size(log, name, last.seg)) break;
+        s.entries.pop_back();
+        ragged = true;
+    }
+    if (ragged && !log->readonly) {
+        if (truncate_file(s.index, s.entries.size() * sizeof(SegEntry)) != 0) {
+            fclose(s.index);
+            return nullptr;
+        }
+    }
+    if (!s.entries.empty()) {
+        s.cur_seg = s.entries.back().seg;
+        s.cur_off = (uint64_t)s.entries.back().off + s.entries.back().len;
+    }
+    if (!log->readonly) {
+        // writer owns the tail segment: open it and cut any bytes past the
+        // validated extent (torn block data with no surviving index entry)
+        s.data = fopen(seg_path(log, name, s.cur_seg).c_str(), "ab+");
+        if (!s.data) {
+            fclose(s.index);
+            return nullptr;
+        }
+        fseek(s.data, 0, SEEK_END);
+        if ((uint64_t)ftell(s.data) != s.cur_off &&
+            truncate_file(s.data, s.cur_off) != 0) {
+            fclose(s.index);
+            fclose(s.data);
+            return nullptr;
+        }
+    }
+    auto res = log->segs.emplace(name, std::move(s));
+    return &res.first->second;
+}
+
+// drop in-process knowledge of deliberate torn bytes (oplog_seg_tear) by
+// truncating the files back to the validated extent — the same cut the
+// open-time scan would make after a real crash
+bool seg_untear(SegStream* s) {
+    fflush(s->data);
+    fflush(s->index);
+    if (truncate_file(s->index, s->entries.size() * sizeof(SegEntry)) != 0 ||
+        truncate_file(s->data, s->cur_off) != 0)
+        return false;
+    s->torn = false;
+    return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -174,7 +295,195 @@ void oplog_close(void* handle) {
         if (kv.second.data) fclose(kv.second.data);
         if (kv.second.index) fclose(kv.second.index);
     }
+    for (auto& kv : log->segs) {
+        if (kv.second.data) fclose(kv.second.data);
+        if (kv.second.index) fclose(kv.second.index);
+    }
     delete log;
+}
+
+// Segment roll threshold for every stream of this handle (testing knob;
+// production leaves the 4 MiB default). Affects future appends only.
+int oplog_seg_config(void* handle, int64_t seg_bytes) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || seg_bytes <= 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    log->seg_bytes = (uint64_t)seg_bytes;
+    return 0;
+}
+
+// Append one column block spanning sequence numbers [first, last] to the
+// segment stream; returns its block ordinal, or -1 on error. Rolls to a
+// fresh segment file when the block would overflow the current one.
+int64_t oplog_seg_append(void* handle, const char* stream, int64_t first,
+                         int64_t last, const void* data, int64_t len,
+                         int64_t btype) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || log->readonly || !stream || !data || len <= 0 ||
+        (uint64_t)len > 0xffffffffu)
+        return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    if (!s) return -1;
+    if (s->torn && !seg_untear(s)) return -1;
+    if (s->cur_off > 0 && s->cur_off + (uint64_t)len > log->seg_bytes) {
+        // roll: "wb+" truncates any stale bytes a crashed roll left behind
+        fclose(s->data);
+        s->cur_seg += 1;
+        s->cur_off = 0;
+        s->data = fopen(seg_path(log, stream, s->cur_seg).c_str(), "wb+");
+        if (!s->data) return -1;
+    }
+    fseek(s->data, 0, SEEK_END);
+    if (fwrite(data, 1, (size_t)len, s->data) != (size_t)len) {
+        fflush(s->data);
+        truncate_file(s->data, s->cur_off);
+        return -1;
+    }
+    SegEntry e;
+    e.first_seq = first;
+    e.last_seq = last;
+    e.seg = s->cur_seg;
+    e.off = (uint32_t)s->cur_off;
+    e.len = (uint32_t)len;
+    e.btype = (uint32_t)btype;
+    fseek(s->index, 0, SEEK_END);
+    if (fwrite(&e, sizeof(e), 1, s->index) != 1) {
+        fflush(s->data);
+        truncate_file(s->data, s->cur_off);
+        return -1;
+    }
+    s->entries.push_back(e);
+    s->cur_off += (uint64_t)len;
+    s->dirty = true;
+    return (int64_t)s->entries.size() - 1;
+}
+
+int64_t oplog_seg_count(void* handle, const char* stream) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !stream) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    return s ? (int64_t)s->entries.size() : -1;
+}
+
+// Read block `ordinal`; same contract as oplog_read (returns the needed
+// size when buflen is too small; -1 on bad args / unknown block). Cold
+// path — the hot read path is the Python-side mmap of the segment files.
+int64_t oplog_seg_read(void* handle, const char* stream, int64_t ordinal,
+                       void* buf, int64_t buflen) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !stream || ordinal < 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    if (!s || (uint64_t)ordinal >= s->entries.size()) return -1;
+    const SegEntry& e = s->entries[(size_t)ordinal];
+    if ((int64_t)e.len > buflen) return (int64_t)e.len;
+    if (s->data) fflush(s->data);
+    FILE* f = fopen(seg_path(log, stream, e.seg).c_str(), "rb");
+    if (!f) return -1;
+    fseek(f, (long)e.off, SEEK_SET);
+    bool ok = fread(buf, 1, e.len, f) == e.len;
+    fclose(f);
+    return ok ? (int64_t)e.len : -1;
+}
+
+// Block metadata for ordinal -> (first, last, seg, off, len, btype).
+int oplog_seg_entry(void* handle, const char* stream, int64_t ordinal,
+                    int64_t* first, int64_t* last, int64_t* seg, int64_t* off,
+                    int64_t* len, int64_t* btype) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !stream || ordinal < 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    if (!s || (uint64_t)ordinal >= s->entries.size()) return -1;
+    const SegEntry& e = s->entries[(size_t)ordinal];
+    if (first) *first = e.first_seq;
+    if (last) *last = e.last_seq;
+    if (seg) *seg = (int64_t)e.seg;
+    if (off) *off = (int64_t)e.off;
+    if (len) *len = (int64_t)e.len;
+    if (btype) *btype = (int64_t)e.btype;
+    return 0;
+}
+
+// Tail the stream for blocks appended by ANOTHER process; admits only
+// complete entries whose block bytes fully landed (cf. oplog_refresh).
+int64_t oplog_seg_refresh(void* handle, const char* stream) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || !stream) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    if (!s) return -1;
+    fseek(s->index, 0, SEEK_END);
+    uint64_t index_bytes = (uint64_t)ftell(s->index);
+    size_t disk_n = (size_t)(index_bytes / sizeof(SegEntry));
+    size_t have = s->entries.size();
+    if (disk_n <= have) return (int64_t)have;
+    fseek(s->index, (long)(have * sizeof(SegEntry)), SEEK_SET);
+    SegEntry e;
+    uint32_t sized_seg = 0;
+    uint64_t sized_bytes = 0;
+    bool sized = false;
+    while (s->entries.size() < disk_n &&
+           fread(&e, sizeof(e), 1, s->index) == 1) {
+        if (!sized || e.seg != sized_seg) {
+            sized_seg = e.seg;
+            sized_bytes = seg_file_size(log, stream, e.seg);
+            sized = true;
+        }
+        if ((uint64_t)e.off + e.len > sized_bytes) break;  // mid-write tail
+        s->entries.push_back(e);
+        s->cur_seg = e.seg;
+        s->cur_off = (uint64_t)e.off + e.len;
+    }
+    return (int64_t)s->entries.size();
+}
+
+// Chaos-plane seam: leave a deliberately torn tail on disk, exactly the
+// residue of a crash mid-append, WITHOUT admitting the block.
+//   mode 0: half the block bytes land, no index entry (crash mid-block)
+//   mode 1: all block bytes land, half an index entry (crash mid-index)
+// The stream stays usable: the next append (or a reopen) runs the
+// torn-tail scan and cuts the residue before writing.
+int oplog_seg_tear(void* handle, const char* stream, int64_t first,
+                   int64_t last, const void* data, int64_t len, int64_t btype,
+                   int64_t mode) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || log->readonly || !stream || !data || len <= 0 ||
+        (uint64_t)len > 0xffffffffu)
+        return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    SegStream* s = get_seg(log, stream);
+    if (!s) return -1;
+    if (s->torn && !seg_untear(s)) return -1;
+    if (s->cur_off > 0 && s->cur_off + (uint64_t)len > log->seg_bytes) {
+        fclose(s->data);
+        s->cur_seg += 1;
+        s->cur_off = 0;
+        s->data = fopen(seg_path(log, stream, s->cur_seg).c_str(), "wb+");
+        if (!s->data) return -1;
+    }
+    size_t nbytes = mode == 0 ? (size_t)(len / 2 ? len / 2 : 1) : (size_t)len;
+    fseek(s->data, 0, SEEK_END);
+    if (fwrite(data, 1, nbytes, s->data) != nbytes) return -1;
+    if (mode != 0) {
+        SegEntry e;
+        e.first_seq = first;
+        e.last_seq = last;
+        e.seg = s->cur_seg;
+        e.off = (uint32_t)s->cur_off;
+        e.len = (uint32_t)len;
+        e.btype = (uint32_t)btype;
+        fseek(s->index, 0, SEEK_END);
+        if (fwrite(&e, 1, sizeof(e) / 2, s->index) != sizeof(e) / 2)
+            return -1;
+    }
+    // flush so the residue is really on disk for a reopen to find
+    fflush(s->data);
+    fflush(s->index);
+    s->torn = true;
+    return 0;
 }
 
 // Append one record; returns its offset (record ordinal), or -1 on error.
@@ -251,6 +560,14 @@ int oplog_flush(void* handle) {
         fflush(kv.second.index);
         kv.second.dirty = false;
     }
+    for (auto& kv : log->segs) {
+        if (!kv.second.dirty) continue;
+        // block bytes before index entry: a reader that sees the entry
+        // must find the bytes (mmap validation re-checks anyway)
+        fflush(kv.second.data);
+        fflush(kv.second.index);
+        kv.second.dirty = false;
+    }
     return 0;
 }
 
@@ -300,6 +617,14 @@ int oplog_sync(void* handle) {
         fflush(kv.second.index);
 #ifndef _WIN32
         fsync(fileno(kv.second.data));
+        fsync(fileno(kv.second.index));
+#endif
+    }
+    for (auto& kv : log->segs) {
+        if (kv.second.data) fflush(kv.second.data);
+        fflush(kv.second.index);
+#ifndef _WIN32
+        if (kv.second.data) fsync(fileno(kv.second.data));
         fsync(fileno(kv.second.index));
 #endif
     }

@@ -18,7 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from fluidframework_tpu.utils.contracts import kernel_contract
-from tools.fluidlint import hygiene, jaxpr_check, layers, wire_check
+from tools.fluidlint import (
+    hygiene,
+    jaxpr_check,
+    layers,
+    storage_check,
+    wire_check,
+)
 
 HERE = os.path.dirname(__file__)
 FIX = os.path.join(HERE, "fixtures", "fluidlint")
@@ -197,6 +203,53 @@ def test_hygiene_catches_all_three(tmp_path):
 
 def test_hygiene_real_tree_clean():
     assert hygiene.check_hygiene(repo_root=REPO) == []
+
+
+# --------------------------------------------------------------- storage
+
+def _storage_tree(tmp_path, durable_log_src, shim=True):
+    """A minimal fake repo tree shaped like the real one."""
+    svc = tmp_path / "fluidframework_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "durable_log.py").write_text(durable_log_src)
+    if shim:
+        (svc / "log_compat.py").write_text("import json\n")
+    return str(tmp_path)
+
+
+def test_storage_json_ban_caught(tmp_path):
+    root = _storage_tree(
+        tmp_path,
+        "import json\n"
+        "def enc(v):\n"
+        "    return json.dumps(v).encode()\n")
+    vs = storage_check.check_storage(repo_root=root)
+    msgs = [v.message for v in vs]
+    assert any("json import in a storage hot-path module" in m
+               for m in msgs), msgs
+    assert any("json.dumps on the storage hot path" in m
+               for m in msgs), msgs
+    assert all("log_compat" not in v.path for v in vs)  # shim exempt
+
+
+def test_storage_missing_shim_caught(tmp_path):
+    root = _storage_tree(tmp_path, "x = 1\n", shim=False)
+    vs = storage_check.check_storage(repo_root=root)
+    assert any("shim module is missing" in v.message for v in vs)
+
+
+def test_storage_undeclared_metric_caught(tmp_path):
+    root = _storage_tree(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('storage.segment.append')\n")  # typo: missing 's'
+    vs = storage_check.check_storage(repo_root=root)
+    assert any('undeclared storage metric "storage.segment.append"'
+               in v.message for v in vs), [v.message for v in vs]
+
+
+def test_storage_real_tree_clean():
+    assert storage_check.check_storage(repo_root=REPO) == []
 
 
 # ------------------------------------------------------------------- CLI

@@ -1,0 +1,532 @@
+"""Columnar durable log: segment store, torn-tail recovery, backfill door.
+
+The storage tier's columnar lane (PR 6) persists each sequenced boxcar
+as ONE packed column block (native/oplog.cpp segment files + a 32-byte
+seq-span index entry); recovery replay is vectorized frombuffer decode,
+and catch-up backfill is binary search over the index plus raw
+byte-range copies served to binary clients verbatim. These tests pin:
+
+- the native segment primitives (append/read/entry, rolls, torn-tail
+  truncation in both tear modes, cross-handle reopen);
+- the mmap'd SegmentReader (tail validation never admits a torn block,
+  range queries stay sound under deli crash-replay span regressions);
+- DurableLog routing (segment lane for deltas topics, record-format
+  directories stay record-format, the legacy_json deprecation counter
+  scoping);
+- the chaos torn seam (a ticketed deltas record SURVIVES a physical
+  tear — unlike the rawops torn, where the client resubmits);
+- the backfill door end to end (zero decodes server-side, retention
+  boundary raising on both sides, columnar == scalar results over a
+  real socket);
+- the legacy _wrap/_unwrap JSON shim round-trip under adversarial
+  tag-key collisions.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.native.oplog import NativeOpLog
+from fluidframework_tpu.protocol import binwire
+from fluidframework_tpu.protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+from fluidframework_tpu.service.array_batch import (
+    ArrayBoxcar,
+    SequencedArrayBatch,
+)
+from fluidframework_tpu.service.durable_log import (
+    DurableLog,
+    _decode_value,
+    _encode_value,
+    _desanitize,
+    _sanitize,
+)
+from fluidframework_tpu.service.log_compat import (
+    _TAG_ESC,
+    _TAG_MSG,
+    decode_json_value,
+    encode_json_value,
+)
+from fluidframework_tpu.service.segment_store import SegmentReader
+
+
+def _boxcar(n=3, tenant="t0", doc="d0", client="c1", ts=12.5):
+    text = "ab" * n
+    text_off = np.arange(0, 2 * n + 2, 2, dtype=np.int32)[: n + 1]
+    return ArrayBoxcar(
+        tenant_id=tenant, document_id=doc, client_id=client,
+        ds_id="root", channel_id="seq", kind=np.zeros(n, np.int8),
+        a=np.arange(n, dtype=np.int32), b=np.zeros(n, np.int32),
+        cseq=np.arange(1, n + 1, dtype=np.int32),
+        rseq=np.zeros(n, np.int32),
+        text=text, text_off=text_off, props=None, timestamp=ts)
+
+
+def _abatch_record(base_seq, n=3, tenant="t0", doc="d0", ts=100.0):
+    box = _boxcar(n, tenant=tenant, doc=doc)
+    return {"tenant_id": tenant, "document_id": doc,
+            "abatch": SequencedArrayBatch(
+                boxcar=box, base_seq=base_seq,
+                msns=np.arange(base_seq, base_seq + n, dtype=np.int64),
+                timestamp=ts)}
+
+
+def _storage_snap(log):
+    return {k: v for k, v in log.counters.snapshot().items()
+            if k.startswith("storage.")}
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+# ===================================================================
+# native segment primitives
+# ===================================================================
+
+def test_native_seg_roundtrip_rolls_and_reopen(tmp_path):
+    d = str(tmp_path)
+    log = NativeOpLog(d)
+    log.seg_config(256)  # tiny threshold: force rolls
+    blocks = []
+    seq = 1
+    for i in range(12):
+        payload = bytes([i]) * (60 + i)
+        blocks.append((seq, seq + 2, payload))
+        assert log.seg_append("s", seq, seq + 2, payload, 1) == i
+        seq += 3
+    assert log.seg_count("s") == 12
+    segs = [f for f in os.listdir(d) if f.startswith("s.seg")
+            and not f.endswith(".segidx")]
+    assert len(segs) > 1, "256-byte threshold never rolled a segment"
+    for i, (first, last, payload) in enumerate(blocks):
+        assert log.seg_read("s", i) == payload
+        e_first, e_last, _seg, _off, e_len, e_btype = log.seg_entry("s", i)
+        assert (e_first, e_last, e_len, e_btype) == (
+            first, last, len(payload), 1)
+    log.close()
+    # a fresh handle over the same directory sees every block
+    log2 = NativeOpLog(d)
+    assert log2.seg_count("s") == 12
+    assert log2.seg_read("s", 7) == blocks[7][2]
+    log2.close()
+
+
+@pytest.mark.parametrize("mode", [0, 1])
+def test_native_torn_tail_truncated_on_reopen(tmp_path, mode):
+    """mode 0 = half the block bytes and no index entry; mode 1 = full
+    block but half an index entry. Both leave ragged bytes the open-time
+    recovery scan must cut; the admitted prefix is untouched and the
+    next append lands cleanly after it."""
+    d = str(tmp_path)
+    log = NativeOpLog(d)
+    good = [b"alpha" * 10, b"bravo" * 10]
+    for i, p in enumerate(good):
+        log.seg_append("s", 10 * i + 1, 10 * i + 5, p, 1)
+    log.seg_tear("s", 21, 25, b"torn-victim" * 8, 1, mode=mode)
+    log.close()
+
+    log2 = NativeOpLog(d)
+    assert log2.seg_count("s") == 2  # the torn tail was never admitted
+    assert log2.seg_read("s", 0) == good[0]
+    assert log2.seg_read("s", 1) == good[1]
+    assert log2.seg_append("s", 21, 25, b"survivor", 1) == 2
+    assert log2.seg_read("s", 2) == b"survivor"
+    log2.close()
+
+
+def test_segment_reader_never_admits_torn_tail(tmp_path):
+    d = str(tmp_path)
+    log = NativeOpLog(d)
+    log.seg_append("s", 1, 3, b"first", 1)
+    reader = SegmentReader(d, "s", flush=log.flush)
+    assert reader.refresh() == 1
+    # a torn index entry (mode 1) must stay invisible to a live tailer
+    log.seg_tear("s", 4, 6, b"ragged" * 4, 1, mode=1)
+    log.flush()
+    assert reader.refresh() == 1
+    assert reader.block(0)[3] == b"first"
+    with pytest.raises(IndexError):
+        reader.block(1)
+    # writer recovery (next append) cuts the tail; the reader then
+    # admits exactly the recovered block
+    log.seg_append("s", 4, 6, b"clean", 1)
+    assert reader.refresh() == 2
+    assert reader.block(1) == (1, 4, 6, b"clean")
+    reader.close()
+    log.close()
+
+
+def test_range_blocks_sound_under_replay_span_regression(tmp_path):
+    """Deli crash-replay re-appends blocks whose seq spans REGRESS below
+    earlier entries (at-least-once duplicates); the index query must
+    still return every overlapping ordinal — plain searchsorted over the
+    raw span columns is unsound here."""
+    d = str(tmp_path)
+    log = NativeOpLog(d)
+    spans = [(1, 3), (4, 6), (7, 9), (4, 6), (10, 12)]  # [3] is a replay
+    for i, (first, last) in enumerate(spans):
+        log.seg_append("s", first, last, b"%d" % i, 1)
+    reader = SegmentReader(d, "s", flush=log.flush)
+    reader.refresh()
+
+    def overlapping(from_seq, to_seq):
+        return [i for i, (f, l) in enumerate(spans)
+                if l > from_seq and f < to_seq]
+
+    rng = random.Random(5)
+    for _ in range(200):
+        a = rng.randrange(-1, 14)
+        b = rng.randrange(-1, 15)
+        assert reader.range_blocks(a, b) == overlapping(a, b), (a, b)
+    assert reader.range_blocks(3, 10) == [1, 2, 3]  # both replay copies
+    reader.close()
+    log.close()
+
+
+# ===================================================================
+# DurableLog: lanes, routing, counters
+# ===================================================================
+
+def test_sanitize_roundtrip_fuzz():
+    rng = random.Random(11)
+    alphabet = "ab_.d/-0"
+    for _ in range(500):
+        topic = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randrange(1, 16)))
+        san = _sanitize(topic)
+        assert "/" not in san
+        assert _desanitize(san) == topic, (topic, san)
+
+
+def test_kind3_raw_boxcar_record_roundtrip():
+    box = _boxcar()
+    data = _encode_value(box)
+    assert data[0] == 0xFF and data[1] == 3
+    out = _decode_value(data)
+    assert (out.tenant_id, out.document_id, out.client_id) == (
+        "t0", "d0", "c1")
+    assert out.text == box.text and np.array_equal(out.a, box.a)
+    assert out.wire_cols is not None  # decode keeps the column bytes
+
+
+def test_durable_log_segment_roundtrip_and_recovery_replay(tmp_path):
+    d = str(tmp_path)
+    topic = "deltas/t0/d0"
+    log = DurableLog(d, segment_bytes=2048)
+    before = _storage_snap(log)
+    seq = 1
+    for i in range(20):
+        rec = _abatch_record(seq, n=3, ts=100.0 + i)
+        log.append(topic, rec)
+        seq += 3
+    after = _storage_snap(log)
+    assert _delta(before, after, "storage.segment.appends") == 20
+    assert _delta(before, after, "storage.log.legacy_json") == 0
+    assert os.path.exists(os.path.join(d, _sanitize(topic) + ".segidx"))
+
+    log._read_cache.clear()
+    v = log.read(topic, 5)
+    assert v["abatch"].base_seq == 16
+    msgs = v["abatch"].messages()
+    assert [m.sequence_number for m in msgs] == [16, 17, 18]
+    log.close()
+
+    # recovery: a fresh process sees every block and decodes on read
+    log2 = DurableLog(d)
+    before = _storage_snap(log2)
+    assert log2.length(topic) == 20
+    replayed = [log2.read(topic, i) for i in range(20)]
+    assert [r["abatch"].base_seq for r in replayed] == \
+        list(range(1, 60, 3))
+    after = _storage_snap(log2)
+    assert _delta(before, after, "storage.segment.decodes") == 20
+    log2.close()
+
+
+def test_record_format_directory_stays_record_lane(tmp_path):
+    """A deltas directory written before the segment store existed must
+    stay record-format for reads AND subsequent writes — mixing lanes
+    would split the topic's order across two files."""
+    d = str(tmp_path)
+    topic = "deltas/t0/d0"
+    old = DurableLog(d, segmented=False)
+    old.append(topic, _abatch_record(1))
+    old.close()
+    assert not any(f.endswith(".segidx") for f in os.listdir(d))
+
+    log = DurableLog(d)  # segmented=True default
+    before = _storage_snap(log)
+    assert log.length(topic) == 1
+    log.append(topic, _abatch_record(4))
+    assert not any(f.endswith(".segidx") for f in os.listdir(d))
+    assert log.length(topic) == 2
+    log._read_cache.clear()
+    assert log.read(topic, 1)["abatch"].base_seq == 4
+    after = _storage_snap(log)
+    assert _delta(before, after, "storage.segment.appends") == 0
+    assert log.delta_blocks(topic, 0, 100) is None  # scalar fallback
+    log.close()
+
+
+def test_legacy_json_counter_scoping(tmp_path):
+    """The deprecation counter tracks the DELTAS lane only: JSON-shaped
+    deltas records count (segment SEG_JSON and record-lane alike);
+    binary kinds and non-deltas topics (checkpoints, rawops) don't."""
+    log = DurableLog(str(tmp_path))
+    before = _storage_snap(log)
+    log.append("rawops/t0/d0", _boxcar())           # kind-3 binary
+    log.append("checkpoints/t0/d0", {"deli": {}})   # non-deltas JSON
+    after = _storage_snap(log)
+    assert _delta(before, after, "storage.log.legacy_json") == 0
+
+    log.append("deltas/t0/d0", {"weird": "record"})  # SEG_JSON shim
+    after2 = _storage_snap(log)
+    assert _delta(after, after2, "storage.log.legacy_json") == 1
+    log._read_cache.clear()
+    assert log.read("deltas/t0/d0", 0) == {"weird": "record"}
+    after3 = _storage_snap(log)
+    assert _delta(after2, after3, "storage.log.legacy_json") == 1
+    log.close()
+
+
+def test_torn_append_on_segment_lane_record_survives(tmp_path):
+    """The chaos torn directive on a segment stream leaves a PHYSICAL
+    ragged tail, then runs the same detect-truncate-rewrite cycle crash
+    recovery runs — and the record itself survives (it is already
+    ticketed; a lost seq would stall every consumer forever)."""
+    d = str(tmp_path)
+    topic = "deltas/t0/d0"
+    log = DurableLog(d)
+
+    pending = ["torn", "torn"]  # exercise both alternating tear modes
+
+    def plane(point, **ctx):
+        if point == "log.append" and ctx["topic"] == topic and pending:
+            return pending.pop()
+        return None
+
+    log.fault_plane = plane
+    before = _storage_snap(log)
+    for i in range(4):
+        log.append(topic, _abatch_record(1 + 3 * i))
+    after = _storage_snap(log)
+    assert _delta(before, after, "storage.segment.torn") == 2
+    assert _delta(before, after, "storage.segment.appends") == 4
+    assert log.length(topic) == 4
+    log.close()
+
+    log2 = DurableLog(d)
+    assert log2.length(topic) == 4
+    assert [log2.read(topic, i)["abatch"].base_seq for i in range(4)] \
+        == [1, 4, 7, 10]
+    log2.close()
+
+
+def test_delta_blocks_zero_decode_byte_range_backfill(tmp_path):
+    """The backfill door serves raw SEG_COLS payloads straight out of
+    the segment mmaps: ZERO decodes server-side (counter-verified), and
+    the payload bytes round-trip through the wire codec to exactly the
+    covered messages. Boundary blocks may span past the range — the
+    client trims."""
+    topic = "deltas/t0/d0"
+    log = DurableLog(str(tmp_path))
+    seq = 1
+    for i in range(50):
+        log.append(topic, _abatch_record(seq, n=3))
+        seq += 3
+    before = _storage_snap(log)
+    res = log.delta_blocks(topic, 10, 40)
+    assert res is not None
+    payloads, legacy = res
+    assert legacy == []
+    after = _storage_snap(log)
+    assert _delta(before, after, "storage.segment.decodes") == 0
+    assert _delta(before, after, "storage.backfill.byterange") == \
+        len(payloads)
+
+    # client-side decode: FT_COLS_DELTAS body -> messages; the trimmed
+    # union covers exactly (10, 40) exclusive
+    seqs = []
+    for p in payloads:
+        _rid, msgs = binwire.read_cols_deltas(
+            binwire.cols_deltas_body(7, p))
+        seqs.extend(m.sequence_number for m in msgs)
+    assert [s for s in sorted(seqs) if 10 < s < 40] == list(range(11, 40))
+    covered = set(range(11, 40))
+    assert covered <= set(seqs)
+    # superset only at block boundaries: nothing beyond one block away
+    assert min(seqs) > 10 - 3 and max(seqs) < 40 + 3
+    log.close()
+
+
+def test_legacy_blocks_materialize_through_shim(tmp_path):
+    """SEG_JSON blocks interleaved in the range come back as in-range
+    message objects (the compat shim), alongside the raw payloads."""
+    topic = "deltas/t0/d0"
+    log = DurableLog(str(tmp_path))
+    log.append(topic, _abatch_record(1, n=3))
+    legacy_msg = SequencedDocumentMessage(
+        client_id="c9", sequence_number=4, minimum_sequence_number=1,
+        client_sequence_number=1, reference_sequence_number=1,
+        type=MessageType.OPERATION, contents={"x": 1}, timestamp=5.0)
+    log.append(topic, {"tenant_id": "t0", "document_id": "d0",
+                       "message": legacy_msg})
+    log.append(topic, _abatch_record(5, n=2))
+    payloads, legacy = log.delta_blocks(topic, 0, 100)
+    assert len(payloads) == 2
+    assert [m.sequence_number for m in legacy] == [4]
+    assert legacy[0] == legacy_msg
+    log.close()
+
+
+# ===================================================================
+# retention boundary + the network backfill door
+# ===================================================================
+
+def test_local_server_backfill_retention_boundary(tmp_path):
+    from fluidframework_tpu.service import LocalServer
+    from fluidframework_tpu.service.scriptorium import LogTruncatedError
+
+    server = LocalServer(log=DurableLog(str(tmp_path)))
+    # drive ops through the real pipeline so the deltas topic fills
+    conn = server.connect("t", "doc")
+    for i in range(10):
+        conn.submit([DocumentMessage(
+            client_sequence_number=i + 1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"i": i})])
+    server.drain()
+    orderer = server._get_orderer("t", "doc")
+    orderer.scriptorium.truncate_below("t", "doc", 5)
+
+    # from_seq == base: allowed (serves (5, to) exclusive)
+    res = server.get_delta_blocks("t", "doc", 5, 100)
+    assert res is not None
+    _payloads, _legacy, head = res
+    assert head == orderer.scriptorium.head_seq("t", "doc")
+    # one below the base: explicit too-far-behind error, never a
+    # silently partial range
+    with pytest.raises(LogTruncatedError) as ei:
+        server.get_delta_blocks("t", "doc", 4, 100)
+    assert ei.value.base == 5
+
+
+def test_network_backfill_door_columnar_equals_scalar(tmp_path):
+    """End to end over a real socket: the connected reply advertises
+    colsBackfill, the driver's columnar get_deltas returns exactly what
+    the scalar door returns (including exclusive-bound trimming), and
+    reaching below the retention base surfaces the driver-local
+    LogTruncatedError with the base attached."""
+    from fluidframework_tpu.driver import NetworkDocumentServiceFactory
+    from fluidframework_tpu.driver.network import LogTruncatedError
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+
+    log = DurableLog(str(tmp_path))
+    server = LocalServer(log=log)
+    fe = NetworkFrontEnd(server).start_background()
+    try:
+        factory = NetworkDocumentServiceFactory("127.0.0.1", fe.port)
+        loader = Loader(factory)
+        c1 = loader.resolve("t", "doc1")
+        s1 = c1.runtime.create_data_store("default") \
+            .create_channel("text", "shared-string")
+        for i in range(50):
+            s1.insert_text(len(s1.get_text()), f"{i % 10}")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(s1.get_text()) < 50:
+            time.sleep(0.05)
+        assert len(s1.get_text()) == 50
+
+        svc = factory.create_document_service("t", "doc1")
+        conn = svc.connect_to_delta_stream()
+        assert conn.cols_backfill is True
+        storage = svc.connect_to_delta_storage()
+
+        before = _storage_snap(log)
+        msgs = storage.get_deltas(0, 1000)
+        seqs = [m.sequence_number for m in msgs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert seqs == [m.sequence_number
+                        for m in server.get_deltas("t", "doc1", 0, 1000)]
+        sub = storage.get_deltas(10, 20)
+        assert [m.sequence_number for m in sub] == \
+            [m.sequence_number
+             for m in server.get_deltas("t", "doc1", 10, 20)]
+        after = _storage_snap(log)
+        assert _delta(before, after, "storage.backfill.byterange") > 0
+        assert _delta(before, after, "storage.segment.decodes") == 0
+
+        orderer = server._get_orderer("t", "doc1")
+        orderer.scriptorium.truncate_below("t", "doc1", 10)
+        assert storage.get_deltas(10, 20)  # at the base: fine
+        with pytest.raises(LogTruncatedError) as ei:
+            storage.get_deltas(9, 20)     # below it: explicit error
+        assert ei.value.base == 10
+        conn.close()
+        c1.close()
+    finally:
+        fe.stop()
+
+
+# ===================================================================
+# the legacy JSON shim (_wrap/_unwrap) under tag collisions
+# ===================================================================
+
+def _rand_json_value(rng, depth=0):
+    r = rng.random()
+    if depth >= 4 or r < 0.35:
+        return rng.choice([
+            None, True, False, 17, -3, 2.5, "plain", "",
+            _TAG_MSG, _TAG_ESC,  # tag names as VALUES must pass through
+        ])
+    if r < 0.55:
+        return [_rand_json_value(rng, depth + 1)
+                for _ in range(rng.randrange(3))]
+    if r < 0.65:
+        return SequencedDocumentMessage(
+            client_id=f"c{rng.randrange(3)}",
+            sequence_number=rng.randrange(100),
+            minimum_sequence_number=0,
+            client_sequence_number=rng.randrange(10),
+            reference_sequence_number=rng.randrange(10),
+            type=MessageType.OPERATION,
+            contents={"p": rng.randrange(5)}, timestamp=1.5)
+    keys = ["a", "b", _TAG_MSG, _TAG_ESC, "c_d"]
+    return {rng.choice(keys): _rand_json_value(rng, depth + 1)
+            for _ in range(rng.randrange(4))}
+
+
+def test_wrap_unwrap_fuzz_roundtrip_with_tag_collisions():
+    """decode(encode(v)) == v for arbitrarily nested JSON-able values
+    whose dict keys COLLIDE with the shim's tag keys (including dicts
+    that look exactly like the wrapped forms), with protocol messages
+    embedded at any depth."""
+    rng = random.Random(1234)
+    for trial in range(300):
+        v = _rand_json_value(rng)
+        out = decode_json_value(encode_json_value(v))
+        assert out == v, (trial, v, out)
+
+
+def test_wrap_unwrap_adversarial_shapes():
+    cases = [
+        {_TAG_MSG: 5},
+        {_TAG_ESC: {_TAG_MSG: 5}},
+        {_TAG_ESC: {_TAG_ESC: {}}},
+        {_TAG_MSG: {_TAG_MSG: {_TAG_MSG: None}}},
+        {_TAG_MSG: 1, _TAG_ESC: 2, "x": 3},
+        [{_TAG_MSG: [{_TAG_ESC: "y"}]}],
+        {"outer": {_TAG_ESC: {"inner": {_TAG_MSG: [1, 2]}}}},
+    ]
+    for v in cases:
+        assert decode_json_value(encode_json_value(v)) == v, v

@@ -9,11 +9,12 @@ import argparse
 import os
 import sys
 
-PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name")
+PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name", "storage")
 
 
 def run(passes, repo_root: str) -> list:
-    from . import hygiene, jaxpr_check, layers, metrics_check, wire_check
+    from . import (hygiene, jaxpr_check, layers, metrics_check,
+                   storage_check, wire_check)
 
     violations = []
     if "layers" in passes:
@@ -28,6 +29,8 @@ def run(passes, repo_root: str) -> list:
         violations += hygiene.check_hygiene(repo_root=repo_root)
     if "metric-name" in passes:
         violations += metrics_check.check_metrics(repo_root=repo_root)
+    if "storage" in passes:
+        violations += storage_check.check_storage(repo_root=repo_root)
     return violations
 
 

@@ -16,7 +16,11 @@ amortization points of the socket tier (see ARCHITECTURE.md
 - two subscribers on one doc — the encode-once fan-out must count
   ``net.fanout.cache_hits``;
 - a read-only frame after quiescence — ``net.flush.elided`` must rise,
-  and the submit batches must have counted ``net.flush.performed``.
+  and the submit batches must have counted ``net.flush.performed``;
+- a catch-up client backfilling the full range through the columnar
+  door — the sequenced stream must have ridden the segment lane
+  (``storage.segment.appends``) and the server must have served raw
+  block byte ranges (``storage.backfill.byterange``).
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -82,9 +86,8 @@ def main() -> int:
                                                 "text": f"c{i}"}}})
 
     tmp = tempfile.mkdtemp(prefix="net-smoke-")
-    front = NetworkFrontEnd(
-        LocalServer(log=DurableLog(os.path.join(tmp, "log")))
-    ).start_background()
+    log = DurableLog(os.path.join(tmp, "log"))
+    front = NetworkFrontEnd(LocalServer(log=log)).start_background()
     factory = NetworkDocumentServiceFactory("127.0.0.1", front.port)
     conn1 = factory.create_document_service(
         "smoke", "doc").connect_to_delta_stream()
@@ -181,8 +184,22 @@ def main() -> int:
     dead_pairs = sorted(p for p in want_pairs
                         if hop_counts.get(p, 0) <= 0)
 
+    # columnar backfill door: a catch-up client pulls the whole op range
+    # through get_deltas_cols — the server must serve raw segment block
+    # byte ranges (storage.backfill.byterange), and the stream itself
+    # must have ridden the columnar segment lane (storage.segment.appends)
+    bf_svc = factory.create_document_service("smoke", "doc")
+    bf_stream = bf_svc.connect_to_delta_stream()
+    bf_msgs = bf_svc.connect_to_delta_storage().get_deltas(0, 10 ** 9)
+    bf_stream.close()
+    if not bf_msgs:
+        print("net_smoke: FAIL — columnar backfill returned no ops",
+              file=sys.stderr)
+        return 1
+
     drv = factory.counters.snapshot()
     srv = front.counters.snapshot()
+    sto = log.counters.snapshot()
     checks = {
         "driver.submit.coalesced": drv.get("driver.submit.coalesced", 0),
         "driver.submit.columnar": drv.get("driver.submit.columnar", 0),
@@ -191,6 +208,9 @@ def main() -> int:
         "net.fanout.cache_hits": srv.get("net.fanout.cache_hits", 0),
         "net.flush.performed": srv.get("net.flush.performed", 0),
         "net.flush.elided": srv.get("net.flush.elided", 0),
+        "storage.segment.appends": sto.get("storage.segment.appends", 0),
+        "storage.backfill.byterange": sto.get(
+            "storage.backfill.byterange", 0),
     }
     frames = drv.get("driver.submit.frames", 0)
     ops = drv.get("driver.submit.ops", 0)
