@@ -694,6 +694,124 @@ def bench_network() -> dict:
             fe.wait(timeout=10)
 
 
+def bench_overload_sweep(knee: dict) -> dict:
+    """Closed-loop overload control at 0.5×–4× the measured knee.
+
+    Every rung runs TWO tenants against a fresh front end with the
+    admission gate armed (``--tenant-rate`` + ``--slo`` on the
+    ``submit_to_admit`` leg — the queueing-visible hop: admit→deli is
+    one event-loop iteration, but frames waiting to be READ show up
+    between the client's submit stamp and the admit stamp):
+
+    - ``bulk``: token bucket capped at 0.9× the knee throughput,
+      offered the swept multiple of the knee load — the shed candidate;
+    - ``steady``: no configured rate (structurally unsheddable), a
+      fixed ~5%-of-knee trickle on every rung — what an innocent
+      co-tenant feels while the neighbor floods.
+
+    The 4× rung repeats with ``--no-shed`` (buckets still account, the
+    SLO still trips, nothing sheds) as the collapse control, and a
+    caps-free pair at 1× (armed vs plain front) prices the windowed
+    registry + SLO ticker themselves. Workers resubmit shed ops after
+    the server's jittered ``retry_after_ms`` (load_async shed lane), so
+    ``acked_frac`` < 1 on a rung means the backlog outlived the
+    worker's ack-wait budget — the honest saturation marker."""
+    import subprocess
+    import time as _time
+
+    knee_rate = knee.get("rate_hz") or 0.0
+    knee_ops = knee.get("ops_per_sec") or 0.0
+    knee_p99 = knee.get("p99_ack_ms") or 50.0
+    if not (knee_rate and knee_ops):
+        return {"skipped": "no knee measurement"}
+    budget_ms = round(max(20.0, 1.5 * knee_p99), 1)
+    cap = round(0.9 * knee_ops, 1)
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3) if vals else None
+
+    def spawn_worker(port, w, tenant, docs, cpd, rate, batch, rounds,
+                     prefix, start_at, timeout):
+        return subprocess.Popen(
+            _lean_cmd("fluidframework_tpu.service.load_async",
+                      "--port", str(port), "--docs", str(docs),
+                      "--clients-per-doc", str(cpd),
+                      "--rounds", str(rounds), "--batch", str(batch),
+                      "--rate", str(rate), "--seed", str(w),
+                      "--start-at", str(start_at), "--tenant", tenant,
+                      "--timeout", str(timeout),
+                      "--doc-prefix", f"{prefix}w{w}d"),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=REPO, env=_lean_env())
+
+    def run_rung(mult, tag, shed=True, caps=True, slo=True):
+        fe_args = ["--port", "0"]
+        if slo:
+            fe_args += ["--slo",
+                        f"overload=submit_to_admit:{budget_ms}:5:2"]
+        if caps:
+            fe_args += ["--tenant-rate", f"bulk:{cap}:{cap}"]
+        if not shed:
+            fe_args.append("--no-shed")
+        fe, port = _spawn_listening(
+            "fluidframework_tpu.service.front_end", *fe_args)
+        try:
+            flood_rate = round(knee_rate * mult, 4)
+            rounds = max(6, int(8 * flood_rate))
+            start_at = _time.time() + 6.0
+            floods = [
+                spawn_worker(port, w, "bulk", 64, 2, flood_rate, 32,
+                             rounds, f"ov{tag}", start_at, 60.0)
+                for w in range(4)]
+            steady = spawn_worker(port, 9, "steady", 16, 2, 2.0, 8, 16,
+                                  f"ov{tag}s", start_at, 60.0)
+            results = []
+            for w in floods + [steady]:
+                out, _ = w.communicate(timeout=300)
+                results.append(json.loads(out))
+            st = results[-1]
+            fl = results[:-1]
+            secs = max(r["seconds"] for r in results)
+            acked = sum(r["acked"] for r in results)
+            offered = sum(r["ops"] for r in results)
+            return {
+                "offered_x": mult,
+                "offered_ops": offered,
+                # goodput over the whole window INCLUDING the ack/drain
+                # wait — the collapse signal the control rung exposes
+                "ops_per_sec": round(acked / secs, 1) if secs else 0.0,
+                "acked_frac": round(acked / offered, 4) if offered else None,
+                "shed_nacks": sum(r.get("shed", 0) for r in results),
+                "steady_p99_ack_ms": pct(st["lat_ms"], 0.99),
+                "steady_acked_frac": (round(st["acked"] / st["ops"], 4)
+                                      if st["ops"] else None),
+                "bulk_p99_ack_ms": pct(
+                    [v for r in fl for v in r["lat_ms"]], 0.99),
+            }
+        finally:
+            fe.terminate()
+            try:
+                fe.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                fe.kill()
+
+    rungs = [run_rung(m, f"s{m}") for m in (0.5, 1.0, 2.0, 4.0)]
+    control = run_rung(4.0, "c", shed=False)
+    ab_armed = run_rung(1.0, "aa", caps=False)
+    ab_plain = run_rung(1.0, "ap", caps=False, slo=False)
+    return {
+        "budget_ms": budget_ms,
+        "bulk_cap_ops_per_sec": cap,
+        "rungs": rungs,
+        "control_no_shed_4x": control,
+        # the SLO/windowed-registry machinery alone (no caps, nothing
+        # sheds): the two throughputs must sit within run-to-run noise
+        "slo_ab": {"armed_ops_per_sec": ab_armed["ops_per_sec"],
+                   "plain_ops_per_sec": ab_plain["ops_per_sec"]},
+    }
+
+
 def bench_sharded(knee_rate: float, run_workers) -> dict:
     """The SHARDED ordering core at the knee geometry (VERDICT r4 #4):
     2 core processes over placement leases, gateways routing by doc
@@ -751,6 +869,7 @@ def main() -> None:
     # network first: the latency measurement must not share the process
     # with a TPU tunnel already saturated by the kernel/service benches
     net = bench_network()
+    overload = bench_overload_sweep(net["knee"])
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -834,6 +953,12 @@ def main() -> None:
                 # rate: the two throughputs must sit within run-to-run
                 # noise of each other
                 "net_trace_ab": net.get("trace_ab", {}),
+                # closed-loop overload control: offered load 0.5×–4× of
+                # the knee against the armed admission gate (capped
+                # "bulk" tenant sheds, uncapped "steady" tenant rides
+                # through), plus the --no-shed collapse control and the
+                # caps-free armed/plain overhead pair
+                "net_overload_sweep": overload,
             }
         )
     )

@@ -13,6 +13,11 @@ one CLI against the ordering core's admin frames (front_end.py
     python -m fluidframework_tpu.admin monitor --port P [--interval S]
                                                [--count N]
     python -m fluidframework_tpu.admin metrics --port P
+    python -m fluidframework_tpu.admin --port P slo
+
+``slo`` prints one row per armed SLO spec — windowed p99 vs budget,
+state (ok/warn/violated), burn progress — plus whether SLO-burn
+shedding is armed (front_end ``--slo`` / ``--no-shed``).
 
 ``monitor`` is the service-monitor role (ref: server/service-monitor):
 each tick it measures the front door's ping RTT (event-loop health) and
@@ -81,28 +86,46 @@ def _frame(args, frame: dict) -> dict:
 
 
 def main(argv=None) -> int:
+    # the connection options are accepted before OR after the
+    # subcommand (`admin --port P slo` and `admin slo --port P` both
+    # work): the sub-level copies default to SUPPRESS so they override
+    # the main-level values only when actually given
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--host", default=argparse.SUPPRESS)
+    common.add_argument("--port", type=int, default=argparse.SUPPRESS)
+    common.add_argument("--admin-secret", default=argparse.SUPPRESS)
     p = argparse.ArgumentParser(description="fluid service admin")
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int, default=None)
     p.add_argument("--admin-secret", default=None)
     sub = p.add_subparsers(dest="cmd", required=True)
-    s = sub.add_parser("status", help="one doc's pipeline status")
+    s = sub.add_parser("status", help="one doc's pipeline status",
+                       parents=[common])
     s.add_argument("tenant")
     s.add_argument("doc")
-    sub.add_parser("docs", help="list live docs")
-    sub.add_parser("tenants", help="list registered tenants")
-    s = sub.add_parser("tenant-add", help="register a tenant")
+    sub.add_parser("docs", help="list live docs", parents=[common])
+    sub.add_parser("tenants", help="list registered tenants",
+                   parents=[common])
+    s = sub.add_parser("tenant-add", help="register a tenant",
+                       parents=[common])
     s.add_argument("id")
     s.add_argument("secret")
-    s = sub.add_parser("tenant-rm", help="deregister a tenant")
+    s = sub.add_parser("tenant-rm", help="deregister a tenant",
+                       parents=[common])
     s.add_argument("id")
-    s = sub.add_parser("monitor", help="live per-doc status ticker")
+    s = sub.add_parser("monitor", help="live per-doc status ticker",
+                       parents=[common])
     s.add_argument("--interval", type=float, default=2.0)
     s.add_argument("--count", type=int, default=0,
                    help="ticks before exiting (0 = forever)")
-    sub.add_parser("metrics",
+    sub.add_parser("metrics", parents=[common],
                    help="Prometheus text scrape of the core's registry")
+    sub.add_parser("slo", parents=[common],
+                   help="armed SLO specs: windowed p99 vs "
+                        "budget, state, burn progress")
     args = p.parse_args(argv)
+    if args.port is None:
+        p.error("--port is required")
 
     if args.cmd == "monitor":
         return _monitor(args)
@@ -117,6 +140,17 @@ def main(argv=None) -> int:
     elif args.cmd == "metrics":
         reply = _request(args, {"t": "admin_metrics_scrape"})
         sys.stdout.write(reply["scrape"])
+    elif args.cmd == "slo":
+        reply = _request(args, {"t": "admin_slo_status"})
+        shed = "armed" if reply.get("shedding") else "off"
+        rows = reply.get("slos", [])
+        print(f"shedding: {shed}  specs: {len(rows)}")
+        for r in rows:
+            scope = r["pair"] + (f"@{r['tenant']}" if r["tenant"] else "")
+            print(f"  {r['slo']}: {scope} p99 {r['p99_ms']}ms / "
+                  f"budget {r['budget_ms']}ms [{r['state']}] "
+                  f"burn {r['burn']}/{r['burn_ticks']} "
+                  f"n={r['count']} window {r['window_s']}s")
     elif args.cmd == "docs":
         reply = _request(args, {"t": "admin_docs"})
         for d in reply["docs"]:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import socket
 import threading
 import time
@@ -329,6 +330,12 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         self._coal_closed = False
         self._inflight_ts: dict[int, float] = {}  # own cseq → submit time
         self._ack_ewma: Optional[float] = None
+        # admission-shed retry state (under _coal_cv): ops the server
+        # nacked with retry_after_ms, held in arrival (= clientSeq)
+        # order; nothing newer may flush past them or the clientSeq
+        # stream would gap at deli
+        self._shed_ops: list = []
+        self._shed_deadline: Optional[float] = None
 
         def on_ops(f):
             for d in f["msgs"]:
@@ -342,8 +349,7 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         transport.on_binary_ops = on_binary_ops
         transport.on_push("op", lambda f: self._deliver(
             "op", message_from_dict(f["msg"])))
-        transport.on_push("nack", lambda f: self._deliver(
-            "nack", message_from_dict(f["nack"])))
+        transport.on_push("nack", self._on_nack_frame)
         transport.on_push("signal", lambda f: self._deliver(
             "signal", message_from_dict(f["signal"])))
         transport.on_disconnect = self._fire_disconnect
@@ -358,6 +364,34 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         # server advertises the columnar backfill door only on direct
         # core connections (a gateway cannot relay the binary pushes)
         self.cols_backfill = bool(reply.get("colsBackfill"))
+
+    def _on_nack_frame(self, f: dict) -> None:
+        """Reader-thread nack dispatch: an admission shed (THROTTLING +
+        retry_after_ms + the op echoed back) is a transparent retry,
+        not an app-visible refusal — hold the op and flush it after the
+        server's backoff. Every other nack delivers to ``on_nack``."""
+        nack = message_from_dict(f["nack"])
+        if (self._binary and nack.retry_after_ms
+                and nack.operation is not None):
+            self._queue_shed_retry(nack.operation, nack.retry_after_ms)
+            return
+        self._deliver("nack", nack)
+
+    def _queue_shed_retry(self, op, retry_ms: int) -> None:
+        # shed nacks arrive in submit (= clientSeq) order, so appending
+        # preserves the resubmit order the server's resume watermark
+        # expects; jitter keeps a shed fleet from re-flooding in
+        # lockstep
+        delay = (retry_ms / 1000.0) * (1.0 + 0.5 * random.random())
+        with self._coal_cv:
+            if self._coal_closed:
+                return
+            self._shed_ops.append(op)
+            self._shed_deadline = max(self._shed_deadline or 0.0,
+                                      time.monotonic() + delay)
+            self._ensure_flusher()
+            self._coal_cv.notify_all()
+        self.counters.inc("driver.submit.shed_retries")
 
     def _deliver(self, kind: str, event) -> None:
         if kind == "op" \
@@ -421,6 +455,13 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             if self._pending_ops:
                 self.counters.inc("driver.submit.coalesced", len(messages))
             self._pending_ops.extend(messages)
+            if self._shed_ops:
+                # a shed backoff is running: the held ops must reach the
+                # wire before anything newer, so this submit parks until
+                # the flusher releases the whole queue at the deadline
+                self._ensure_flusher()
+                self._coal_cv.notify_all()
+                return
             if self._send_inflight:
                 # the in-flight flush drains the buffer before it parks:
                 # these ops ride the next boxcar without a new wakeup
@@ -456,9 +497,10 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             with self._coal_cv:
                 if self._coal_closed:
                     return
-                d = self._flush_deadline
+                d = (self._shed_deadline if self._shed_ops
+                     else self._flush_deadline)
                 if d is None or self._send_inflight \
-                        or not self._pending_ops:
+                        or not (self._pending_ops or self._shed_ops):
                     self._coal_cv.wait(0.1)
                     continue
                 now = time.monotonic()
@@ -469,9 +511,9 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             try:
                 self._drain_and_send()
             except OSError:
-                # peer gone mid-flush: the reader thread sees the dead
-                # socket and runs the disconnect path; pending ops are
-                # the caller's to resubmit after reconnect
+                # peer gone mid-flush: _send_ops already requeued the
+                # unsent tail; a genuinely dead socket is the reader
+                # thread's to notice and turn into a disconnect
                 pass
 
     def _drain_and_send(self) -> None:
@@ -481,7 +523,20 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         try:
             while True:
                 with self._coal_cv:
-                    ops = self._pending_ops
+                    if self._shed_ops:
+                        now = time.monotonic()
+                        if (self._shed_deadline is not None
+                                and now < self._shed_deadline):
+                            # backoff still running: nothing may pass
+                            # the held ops (clientSeq order); the
+                            # flusher re-enters at the deadline
+                            self._flush_deadline = None
+                            return
+                        ops = self._shed_ops + self._pending_ops
+                        self._shed_ops = []
+                        self._shed_deadline = None
+                    else:
+                        ops = self._pending_ops
                     self._flush_deadline = None
                     if not ops:
                         return
@@ -494,50 +549,71 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
 
     def _send_ops(self, ops: list) -> None:
         for i in range(0, len(ops), _MAX_BOXCAR_OPS):
-            chunk = ops[i:i + _MAX_BOXCAR_OPS]
-            sample = False
-            if self.trace_sample_n:
-                self._trace_seq += 1
-                sample = self._trace_seq % self.trace_sample_n == 0
-            # columnar first: a canonical chanop boxcar rides the
-            # fixed-stride column frame the server admits without
-            # materializing per-op objects (kind stays "submit" so the
-            # chaos net.send rules fault both frame families alike)
-            columnar = False
-            body = binwire.encode_submit_columns(chunk)
+            try:
+                self._send_chunk(ops[i:i + _MAX_BOXCAR_OPS])
+            except OSError:
+                # the peer stopped reading long enough for the send to
+                # fail (buffer-full timeout under a nack storm): the
+                # drained batch must NOT vanish — requeue everything
+                # unsent at the head of the shed lane and back off. If
+                # the failure left a partial frame on the wire the
+                # server's framed read breaks and drops the connection,
+                # which runs the visible disconnect path; either way an
+                # op is never lost silently.
+                with self._coal_cv:
+                    if not self._coal_closed:
+                        self._shed_ops[:0] = ops[i:]
+                        self._shed_deadline = max(
+                            self._shed_deadline or 0.0,
+                            time.monotonic() + 0.5)
+                        self._ensure_flusher()
+                        self._coal_cv.notify_all()
+                raise
+
+    def _send_chunk(self, chunk: list) -> None:
+        sample = False
+        if self.trace_sample_n:
+            self._trace_seq += 1
+            sample = self._trace_seq % self.trace_sample_n == 0
+        # columnar first: a canonical chanop boxcar rides the
+        # fixed-stride column frame the server admits without
+        # materializing per-op objects (kind stays "submit" so the
+        # chaos net.send rules fault both frame families alike)
+        columnar = False
+        body = binwire.encode_submit_columns(chunk)
+        if body is not None:
+            columnar = True
+            if sample:
+                # hoptail append keeps the op columns untouched —
+                # stamping traces on the op itself would kick the
+                # boxcar off the columnar path entirely
+                body = binwire.append_hop(
+                    body, HOP_SUBMIT, time.time())
+                self.counters.inc("driver.trace.sampled")
+        else:
+            if sample:
+                chunk[-1].traces.append(TraceHop(
+                    service="client", action="submit",
+                    timestamp=time.time()))
+                self.counters.inc("driver.trace.sampled")
+            try:
+                body = binwire.encode_submit(chunk)
+            except Exception:
+                # a boxcar binwire cannot pack (>u16 ops, int outside
+                # the fixed-field range) still goes through: the
+                # server accepts both frame kinds on any connection
+                body = None
+        with self._t.lock:
             if body is not None:
-                columnar = True
-                if sample:
-                    # hoptail append keeps the op columns untouched —
-                    # stamping traces on the op itself would kick the
-                    # boxcar off the columnar path entirely
-                    body = binwire.append_hop(
-                        body, HOP_SUBMIT, time.time())
-                    self.counters.inc("driver.trace.sampled")
+                self._t.send_body(body, kind="submit")
             else:
-                if sample:
-                    chunk[-1].traces.append(TraceHop(
-                        service="client", action="submit",
-                        timestamp=time.time()))
-                    self.counters.inc("driver.trace.sampled")
-                try:
-                    body = binwire.encode_submit(chunk)
-                except Exception:
-                    # a boxcar binwire cannot pack (>u16 ops, int outside
-                    # the fixed-field range) still goes through: the
-                    # server accepts both frame kinds on any connection
-                    body = None
-            with self._t.lock:
-                if body is not None:
-                    self._t.send_body(body, kind="submit")
-                else:
-                    self._t.send(
-                        {"t": "submit",
-                         "ops": [message_to_dict(m) for m in chunk]})
-            self.counters.inc("driver.submit.frames")
-            self.counters.inc("driver.submit.ops", len(chunk))
-            if columnar:
-                self.counters.inc("driver.submit.columnar")
+                self._t.send(
+                    {"t": "submit",
+                     "ops": [message_to_dict(m) for m in chunk]})
+        self.counters.inc("driver.submit.frames")
+        self.counters.inc("driver.submit.ops", len(chunk))
+        if columnar:
+            self.counters.inc("driver.submit.columnar")
 
     def submit_signal(self, content: Any, type: str = "signal") -> None:
         self._t.send({"t": "signal", "content": content, "type": type})
@@ -561,7 +637,10 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             deadline = time.monotonic() + 0.5
             while self._send_inflight and time.monotonic() < deadline:
                 self._coal_cv.wait(0.05)
-            pending, self._pending_ops = self._pending_ops, []
+            # held shed ops flush too (ahead of the buffer — clientSeq
+            # order holds even on the close path)
+            pending = self._shed_ops + self._pending_ops
+            self._shed_ops, self._pending_ops = [], []
             self._coal_cv.notify_all()
         if pending:
             try:

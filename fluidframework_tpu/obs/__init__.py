@@ -12,8 +12,14 @@ from .flight import (  # noqa: F401
 )
 from .metrics import (  # noqa: F401
     MetricsRegistry,
+    WindowedSeries,
     get_registry,
     parse_prometheus,
     reset_registry,
     tier_counters,
+)
+from .slo import (  # noqa: F401
+    SloEngine,
+    SloSpec,
+    parse_slo_spec,
 )

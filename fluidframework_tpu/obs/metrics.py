@@ -30,7 +30,10 @@ Dotted metric names (``tier.noun.verb`` — enforced by the fluidlint
 
 from __future__ import annotations
 
+import math
+import random
 import threading
+import time
 import weakref
 from typing import Optional
 
@@ -38,6 +41,10 @@ from ..utils.telemetry import Counters, percentile
 
 #: Distinct label sets allowed per metric name before overflow.
 DEFAULT_MAX_SERIES = 256
+
+#: Windowed-series defaults: ten one-second buckets per series.
+DEFAULT_WINDOW_S = 10.0
+DEFAULT_WINDOW_BUCKETS = 10
 
 _PREFIX = "fluid_"
 
@@ -49,25 +56,104 @@ def _prom_name(name: str) -> str:
 def _prom_labels(labels: tuple) -> str:
     if not labels:
         return ""
+    # exposition-spec label escaping: backslash, double quote, and
+    # newline (a raw \n would split the sample across two lines and
+    # corrupt the whole line-oriented scrape)
     inner = ",".join(
-        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\")
+                     .replace('"', '\\"').replace("\n", "\\n"))
         for k, v in labels)
     return "{" + inner + "}"
 
 
 class _Series:
-    """One observation series: true count + bounded sample list."""
+    """One observation series: true count + bounded uniform reservoir
+    (seeded, same scheme as ``Counters.observe``) — lifetime quantiles
+    keep representing the whole stream instead of the first 4096
+    warmup samples."""
 
-    __slots__ = ("count", "samples")
+    __slots__ = ("count", "samples", "_rng")
 
     def __init__(self):
         self.count = 0
         self.samples: list[float] = []
+        self._rng = random.Random(0)
 
     def add(self, value: float, max_samples: int = 4096) -> None:
         self.count += 1
         if len(self.samples) < max_samples:
             self.samples.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < max_samples:
+                self.samples[j] = value
+
+
+class WindowedSeries:
+    """Epoch-ring windowed observations: ``buckets`` fixed-width time
+    buckets spanning the trailing ``window_s`` seconds.
+
+    ``observe`` is O(1): a value lands in the bucket indexed by its
+    epoch (``now // width``) modulo the ring size, and a bucket whose
+    stored epoch went stale is reset in place — that lazy reset IS the
+    rotation, so an idle series costs nothing. Reads merge the samples
+    of every bucket still inside the window, so quantiles reflect the
+    last window, not process lifetime (the cumulative ``_Series``
+    keeps that role). Per-bucket samples are a seeded reservoir with
+    the true count kept separately."""
+
+    __slots__ = ("width", "buckets", "max_per_bucket", "_epochs",
+                 "_counts", "_samples", "_rng")
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 buckets: int = DEFAULT_WINDOW_BUCKETS,
+                 max_per_bucket: int = 512):
+        self.width = window_s / buckets
+        self.buckets = buckets
+        self.max_per_bucket = max_per_bucket
+        self._epochs = [-1] * buckets
+        self._counts = [0] * buckets
+        self._samples: list[list[float]] = [[] for _ in range(buckets)]
+        self._rng = random.Random(0)
+
+    def observe(self, value: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        e = int(now / self.width)
+        i = e % self.buckets
+        if self._epochs[i] != e:
+            self._epochs[i] = e
+            self._counts[i] = 0
+            self._samples[i] = []
+        n = self._counts[i] = self._counts[i] + 1
+        s = self._samples[i]
+        if len(s) < self.max_per_bucket:
+            s.append(value)
+        else:
+            j = self._rng.randrange(n)
+            if j < self.max_per_bucket:
+                s[j] = value
+
+    def stats(self, now: Optional[float] = None,
+              window_s: Optional[float] = None) -> tuple[int, list]:
+        """(true count, merged samples) over the live window — or over
+        the trailing ``window_s`` seconds when narrower than the ring."""
+        now = time.monotonic() if now is None else now
+        e = int(now / self.width)
+        span = self.buckets
+        if window_s is not None:
+            span = max(1, min(span, math.ceil(window_s / self.width)))
+        lo = e - span + 1
+        count = 0
+        merged: list[float] = []
+        for i in range(self.buckets):
+            if self._epochs[i] >= lo:
+                count += self._counts[i]
+                merged.extend(self._samples[i])
+        return count, merged
+
+    def quantile(self, p: float, now: Optional[float] = None) -> float:
+        _, merged = self.stats(now)
+        return percentile(sorted(merged), p)
 
 
 class MetricsRegistry:
@@ -80,6 +166,7 @@ class MetricsRegistry:
         self._counters: dict[str, dict[tuple, float]] = {}
         self._gauges: dict[str, dict[tuple, float]] = {}
         self._observations: dict[str, dict[tuple, _Series]] = {}
+        self._windows: dict[str, dict[tuple, WindowedSeries]] = {}
         # (tier, weakref-to-Counters) — scrape aggregates the live ones
         self._tiers: list[tuple[str, weakref.ref]] = []
         self.series_dropped = 0
@@ -112,6 +199,39 @@ class MetricsRegistry:
             key = self._labelset(self._observations, name, labels)
             series = self._observations[name].setdefault(key, _Series())
             series.add(value)
+
+    def observe_windowed(self, name: str, value: float,
+                         now: Optional[float] = None, **labels) -> None:
+        """Record into the windowed twin of a summary series.
+
+        Called per sampled boxcar / batch, never per op — the registry
+        lock stays off the op hot path. ``now`` (monotonic seconds) is
+        injectable so SLO tests can drive a frozen clock."""
+        with self._lock:
+            key = self._labelset(self._windows, name, labels)
+            series = self._windows[name].setdefault(key, WindowedSeries())
+            series.observe(value, now)
+
+    def window_stats(self, name: str, now: Optional[float] = None,
+                     window_s: Optional[float] = None,
+                     quantiles: tuple = (0.5, 0.99),
+                     **labels) -> tuple[int, dict]:
+        """(count, {q: value}) over the live window, merged across every
+        label set matching the (subset) filter — e.g. ``pair=...`` alone
+        merges all tenants of that pair."""
+        want = [(k, str(v)) for k, v in labels.items()]
+        with self._lock:
+            table = self._windows.get(name, {})
+            matched = [ws for key, ws in table.items()
+                       if all(kv in key for kv in want)]
+        count = 0
+        merged: list[float] = []
+        for ws in matched:
+            c, s = ws.stats(now, window_s)
+            count += c
+            merged.extend(s)
+        merged.sort()
+        return count, {q: percentile(merged, q) for q in quantiles}
 
     def register_tier(self, tier: str, counters: Counters) -> None:
         """Track a tier's Counters weakly: the hot path keeps writing
@@ -153,6 +273,11 @@ class MetricsRegistry:
             gauges = {n: dict(t) for n, t in self._gauges.items()}
             observations = {n: dict(t)
                             for n, t in self._observations.items()}
+            # snapshot windowed stats under the lock: (count, samples)
+            # per live window, rendered as summaries below
+            windows = {
+                n: {key: ws.stats() for key, ws in t.items()}
+                for n, t in self._windows.items()}
             dropped = self.series_dropped
         for (name, key), v in tier_counts.items():
             counters.setdefault(name, {})
@@ -192,6 +317,19 @@ class MetricsRegistry:
                     f"{pn}_count{_prom_labels(key)} {s.count:g}")
                 lines.append(
                     f"{pn}_sum{_prom_labels(key)} {sum(s.samples):g}")
+        for name in sorted(windows):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} summary")
+            for key in sorted(windows[name]):
+                count, samples = windows[name][key]
+                vals = sorted(samples)
+                for q in (0.5, 0.99):
+                    lines.append(
+                        f"{pn}{_prom_labels(key + (('quantile', q),))} "
+                        f"{percentile(vals, q):g}")
+                lines.append(f"{pn}_count{_prom_labels(key)} {count:g}")
+                lines.append(
+                    f"{pn}_sum{_prom_labels(key)} {sum(samples):g}")
         return "\n".join(lines) + "\n"
 
 
@@ -262,7 +400,9 @@ def parse_prometheus(text: str) -> dict:
                 while i < len(body):
                     ch = body[i]
                     if esc:
-                        out_chars.append(ch)
+                        # exposition escapes: \\ \" and \n (the writer
+                        # half in _prom_labels emits exactly these)
+                        out_chars.append("\n" if ch == "n" else ch)
                         esc = False
                     elif ch == "\\":
                         esc = True

@@ -111,6 +111,9 @@ class Nack:
     type: NackErrorType
     message: str = ""
     retry_after_seconds: Optional[float] = None
+    # admission-shed nacks: how long the client should back off before
+    # resubmitting this op (jittered client-side; see driver/network.py)
+    retry_after_ms: Optional[int] = None
 
 
 @dataclass(slots=True)

@@ -84,6 +84,10 @@ _ENCODERS = {
         "type": m.type,
         "message": m.message,
         "retry_after_seconds": m.retry_after_seconds,
+        # omitted when unset: pre-overload-control nacks must stay
+        # byte-identical (format freeze, tests/test_compat.py)
+        **({} if m.retry_after_ms is None
+           else {"retry_after_ms": m.retry_after_ms}),
     },
     Signal: lambda m: {
         "_kind": "signal",

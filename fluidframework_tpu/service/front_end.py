@@ -65,6 +65,7 @@ from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
 from ..utils.telemetry import HOP_ADMIT, HOP_SERVICE_ACTION, hop_pairs
+from .admission import AdmissionController, retry_after_ms
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
 from .scriptorium import LogTruncatedError
@@ -78,7 +79,7 @@ def _encode_frame(obj: dict) -> bytes:
     return len(body).to_bytes(4, "big") + body
 
 
-def _stamp_abatch(batch, topic=None) -> bytes:
+def _stamp_abatch(batch, topic=None, tenant=None) -> bytes:
     """Sequenced columnar broadcast body: splice deli's stamp onto the
     column bytes the submit frame carried (zero re-encode); a boxcar
     that arrived without them (in-proc submit_array, durable replay)
@@ -98,9 +99,20 @@ def _stamp_abatch(batch, topic=None) -> bytes:
             box.cseq, box.rseq, box.text, box.text_off, box.props)
     hops = box.hops
     if hops:
+        if tenant is None and topic:
+            tenant = topic.partition("/")[0]
         reg = get_registry()
         for pair, ms in hop_pairs(hops):
-            reg.observe("obs.hop.ms", ms, pair=pair)
+            # cumulative summary (lifetime) and its windowed twin (the
+            # SLO engine's read source) — both per sampled batch only,
+            # labeled by tenant when the egress point knows it
+            if tenant:
+                reg.observe("obs.hop.ms", ms, pair=pair, tenant=tenant)
+                reg.observe_windowed("obs.hop.window_ms", ms,
+                                     pair=pair, tenant=tenant)
+            else:
+                reg.observe("obs.hop.ms", ms, pair=pair)
+                reg.observe_windowed("obs.hop.window_ms", ms, pair=pair)
     return binwire.stamp_cols_ops(cols, box.client_id, batch.base_seq,
                                   batch.msns, batch.timestamp, topic=topic,
                                   hops=hops)
@@ -135,6 +147,11 @@ async def _read_body(reader: asyncio.StreamReader) -> Optional[bytes]:
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     body = await _read_body(reader)
     return None if body is None else json.loads(body.decode())
+
+
+#: Bulk backfill frame types deferred behind the interactive ops of
+#: the same ingress wave (see _handle_conn lane priority).
+_BULK_FRAMES = ("get_deltas_cols", "get_deltas")
 
 
 def _frame_buffered(reader: asyncio.StreamReader) -> bool:
@@ -280,11 +297,16 @@ class _ClientSession:
             raw = slots[0]
             if raw is None:
                 try:
-                    raw = binwire.frame(_stamp_abatch(batch))
+                    raw = binwire.frame(
+                        _stamp_abatch(batch, tenant=conn.tenant_id))
                 except Exception:
                     raw = False
                 slots[0] = raw
                 front.counters.inc("net.fanout.encodes")
+                # per-tenant fan-out accounting: once per encode (the
+                # cache makes that once per batch), not per subscriber
+                get_registry().inc("net.fanout.batches", batch.n,
+                                   tenant=conn.tenant_id)
             else:
                 front.counters.inc("net.fanout.cache_hits")
             if raw is not False:
@@ -357,6 +379,7 @@ class _ClientSession:
                 # 16KB limit, localDeltaConnectionServer.ts:96)
                 ops = self._filter_oversized(
                     [message_from_dict(d) for d in frame["ops"]], None, None)
+                ops = self._admit_or_shed(self.conn, ops, None)
                 if ops:
                     self.conn.submit(ops)
                     self.front._dirty_servers.add(self.conn.server)
@@ -412,7 +435,8 @@ class _ClientSession:
                 self._handle_gateway(t, frame, rid)
             elif t in ("admin_status", "admin_docs", "admin_tenants",
                        "admin_counters", "admin_metrics_scrape",
-                       "admin_tenant_add", "admin_tenant_remove"):
+                       "admin_slo_status", "admin_tenant_add",
+                       "admin_tenant_remove"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -443,6 +467,7 @@ class _ClientSession:
                 _, ops, spans, blob, npool = binwire.decode_submit(
                     body, with_spans=True)
                 ops = self._filter_oversized(ops, len(body), None)
+                ops = self._admit_or_shed(self.conn, ops, None)
                 if ops:
                     _stamp_admit(ops)
                     # expose the splice context for the SYNCHRONOUS
@@ -459,6 +484,7 @@ class _ClientSession:
                     body, with_spans=True)
                 conn = self._fsessions[sid]
                 ops = self._filter_oversized(ops, len(body), sid)
+                ops = self._admit_or_shed(conn, ops, sid)
                 if ops:
                     _stamp_admit(ops)
                     self.front._splice_ctx = (spans, blob, npool)
@@ -510,6 +536,45 @@ class _ClientSession:
                 kept.append(op)
         return kept
 
+    def _admit_or_shed(self, conn, ops: list, sid) -> list:
+        """THE admission gate: every rec-lane submit door passes its
+        ops through here after the size filter (the columnar door runs
+        the same check on its packed columns in ``_submit_columns``).
+        Also the per-tenant ingress accounting point — one labeled
+        registry inc per boxcar, never per op."""
+        if not ops:
+            return ops
+        get_registry().inc("net.ingress.ops", len(ops),
+                           tenant=conn.tenant_id)
+        adm = self.front.admission
+        if adm is None:
+            return ops
+        retry_s = adm.check(conn, len(ops),
+                            ops[0].client_sequence_number)
+        if retry_s <= 0.0:
+            return ops
+        self._push_shed_nacks(ops, retry_s, sid)
+        return []
+
+    def _push_shed_nacks(self, ops: list, retry_s: float, sid) -> None:
+        """Shed a whole boxcar through the shared nack door: one
+        THROTTLING nack per op carrying the op itself plus
+        ``retry_after_ms``, pushed over the same wire (or fnack-muxed
+        for gateway clients) as every other refusal — the driver
+        resubmits transparently after the backoff."""
+        ms = retry_after_ms(retry_s)
+        for op in ops:
+            nack = Nack(
+                operation=op, sequence_number=-1, code=429,
+                type=NackErrorType.THROTTLING,
+                message="tenant over admission budget",
+                retry_after_ms=ms)
+            if sid is None:
+                self.push("nack", {"nack": message_to_dict(nack)})
+            else:
+                self.push("fnack", {"sid": sid,
+                                    "nack": message_to_dict(nack)})
+
     def _submit_columns(self, body: bytes) -> None:
         """Columnar ingress: hand a submit boxcar to deli's array lane
         with the op payload still in packed columns.
@@ -533,6 +598,20 @@ class _ClientSession:
                 raise RuntimeError("submit before connect")
         else:
             conn = self._fsessions[sid]
+        n = len(sc.cseq)
+        if n:
+            get_registry().inc("net.ingress.ops", n,
+                               tenant=conn.tenant_id)
+            adm = front.admission
+            if adm is not None:
+                retry_s = adm.check(conn, n, int(sc.cseq[0]))
+                if retry_s > 0.0:
+                    # shed is the cold path: materialize the ops once
+                    # so the per-op nacks are byte-identical to the
+                    # rec door's
+                    self._push_shed_nacks(binwire.cols_to_ops(sc),
+                                          retry_s, sid)
+                    return
         limit = front.max_message_size
         if (getattr(conn, "can_write", True)
                 and 6 * len(body) + 512 <= limit):
@@ -606,6 +685,9 @@ class _ClientSession:
                                 self.front._fops_cache = (key, raw)
                                 self.front.counters.inc(
                                     "net.fanout.encodes")
+                                get_registry().inc(
+                                    "net.fanout.batches", batch.n,
+                                    tenant=topic.partition("/")[0])
                             else:
                                 self.front.counters.inc(
                                     "net.fanout.cache_hits")
@@ -684,6 +766,7 @@ class _ClientSession:
             ops = self._filter_oversized(
                 [message_from_dict(d) for d in frame["ops"]], None,
                 frame["sid"])
+            ops = self._admit_or_shed(conn, ops, frame["sid"])
             if ops:
                 conn.submit(ops)
                 self.front._dirty_servers.add(conn.server)
@@ -814,6 +897,15 @@ class _ClientSession:
             # every live tier Counters plus the labeled hop-pair series
             self.push("admin", {"rid": rid,
                                 "scrape": get_registry().scrape()})
+        elif t == "admin_slo_status":
+            # read-only: per-spec health rows from the SLO engine (the
+            # `admin slo` CLI view); no engine → empty list, not an error
+            engine = front.slo_engine
+            self.push("admin", {
+                "rid": rid,
+                "slos": engine.status() if engine is not None else [],
+                "shedding": (front.admission.shedding
+                             if front.admission is not None else False)})
         elif t == "admin_tenant_add":
             if tenants is None:
                 from .tenants import TenantManager
@@ -1081,12 +1173,37 @@ class NetworkFrontEnd:
                            or hasattr(self.server.log, "flush"))
         # (tenant, doc) → applied seq reported by an applier stage
         self.applier_status: dict = {}
+        # overload-control loop: the admission gate stays None (one
+        # attribute check on the submit path) until a tenant rate or an
+        # SLO engine is attached
+        self.admission: Optional[AdmissionController] = None
+        self.slo_engine = None
         # live _ClientSessions (lease-loss teardown walks these)
         self._sessions: set = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
         self._aio_server: Optional[asyncio.base_events.Server] = None
+
+    def enable_admission(self) -> AdmissionController:
+        """The admission gate, created on first use; rates are re-read
+        from the tenant registry per boxcar so runtime changes apply."""
+        if self.admission is None:
+            self.admission = AdmissionController(self._rate_for)
+        return self.admission
+
+    def _rate_for(self, tenant: str):
+        tm = self.server.tenants
+        return None if tm is None else tm.rate_for(tenant)
+
+    def attach_slo(self, engine, shedding: bool = True) -> "NetworkFrontEnd":
+        """Close the loop: the engine's windowed verdicts arm (or, with
+        ``shedding=False``, merely observe) the admission gate."""
+        self.slo_engine = engine
+        adm = self.enable_admission()
+        adm.engine = engine
+        adm.shedding = shedding
+        return self
 
     def server_for(self, tenant: str, doc: str) -> LocalServer:
         """The LocalServer serving this doc: the single pipeline, or the
@@ -1174,18 +1291,32 @@ class NetworkFrontEnd:
                 # fixed cost of the socket tier. The cap keeps one hot
                 # connection from starving its peers on the loop.
                 n = 0
+                deferred: list = []
                 while body is not None:
                     n += 1
                     recorder.frame(conn_id, "in", body)
                     if binwire.is_binary(body):
                         session.handle_binary(body)
                     else:
-                        session.handle(json.loads(body.decode()))
+                        frame = json.loads(body.decode())
+                        if frame.get("t") in _BULK_FRAMES:
+                            # lane priority: bulk backfill yields to the
+                            # interactive ops of the same wave — a
+                            # catch-up client's multi-MB range read must
+                            # not sit between a submit and its ack
+                            deferred.append(frame)
+                        else:
+                            session.handle(frame)
                     body = None
                     if n < 64 and _frame_buffered(reader):
                         # completes synchronously — the bytes are
                         # already in the stream buffer
                         body = await _read_body(reader)
+                for frame in deferred:
+                    session.handle(frame)
+                if deferred:
+                    counters.inc("net.ingress.deprioritized",
+                                 len(deferred))
                 counters.inc("net.ingress.frames", n)
                 counters.inc("net.ingress.batches")
                 if n > 1:
@@ -1388,6 +1519,41 @@ class NetworkFrontEnd:
         loop.run_forever()
 
 
+def _apply_overload_flags(front: "NetworkFrontEnd", args, parser) -> None:
+    """Arm the overload-control loop from the CLI flags: per-tenant
+    rate caps into the tenant registry, SLO specs into a ticking
+    engine attached to the admission gate."""
+    if args.tenant_rate:
+        from .tenants import TenantManager
+
+        tm = front.server.tenants
+        if tm is None:
+            # rates alone must NOT flip tenancy to enforcing — the
+            # registry stays secret-less (open auth) and only carries
+            # the budgets
+            tm = front.server.tenants = TenantManager()
+            for server in front._all_servers():
+                server.tenants = tm
+        for spec in args.tenant_rate:
+            parts = spec.split(":")
+            try:
+                tm.set_rate(parts[0], float(parts[1]),
+                            float(parts[2]) if len(parts) > 2 else None)
+            except (IndexError, ValueError):
+                parser.error(f"bad --tenant-rate {spec!r} "
+                             "(want ID:RATE[:BURST])")
+        front.enable_admission()
+    if args.slo:
+        from ..obs.slo import SloEngine, parse_slo_spec
+
+        try:
+            specs = [parse_slo_spec(s) for s in args.slo]
+        except ValueError as e:
+            parser.error(str(e))
+        front.attach_slo(SloEngine(specs).start(),
+                         shedding=not args.no_shed)
+
+
 def main() -> None:
     import gc
 
@@ -1431,6 +1597,19 @@ def main() -> None:
     parser.add_argument("--admin-secret", default=None,
                         help="shared secret gating the admin RPCs "
                              "(required when tenancy is enforcing)")
+    # overload-control loop (see service/admission.py + obs/slo.py)
+    parser.add_argument("--tenant-rate", action="append", default=[],
+                        metavar="ID:RATE[:BURST]",
+                        help="cap a tenant's admission rate in ops/s "
+                             "(unlisted tenants stay unlimited)")
+    parser.add_argument("--slo", action="append", default=[],
+                        metavar="NAME=PAIR[@TENANT]:BUDGET_MS"
+                                "[:WINDOW_S[:BURN_TICKS]]",
+                        help="arm a windowed p99 SLO; a sustained burn "
+                             "sheds over-budget tenants")
+    parser.add_argument("--no-shed", action="store_true",
+                        help="evaluate SLOs but never shed (the "
+                             "overload bench's control arm)")
     args = parser.parse_args()
     if args.shard_dir is not None:
         import gc as _gc
@@ -1459,6 +1638,7 @@ def main() -> None:
                                 max_message_size=args.max_message_size,
                                 shard_host=shard_host,
                                 admin_secret=args.admin_secret)
+        _apply_overload_flags(front, args, parser)
         front.serve_forever()
         return
     server = None
@@ -1503,6 +1683,7 @@ def main() -> None:
     front = NetworkFrontEnd(server=server, host=args.host, port=args.port,
                             max_message_size=args.max_message_size,
                             admin_secret=args.admin_secret)
+    _apply_overload_flags(front, args, parser)
     for state_dir in args.consume_backchannel:
         front.attach_backchannel(state_dir)
     front.serve_forever()

@@ -40,7 +40,8 @@ from typing import Optional
 from ..obs import get_recorder
 from ..protocol import binwire
 from ..utils.telemetry import HOP_RELAY
-from .front_end import _encode_frame, _frame_buffered, _read_body
+from .front_end import (_BULK_FRAMES, _encode_frame, _frame_buffered,
+                        _read_body)
 
 
 class _GatewaySession:
@@ -412,6 +413,7 @@ class Gateway:
                 # client's coalesced submit burst costs one drain, not
                 # one per frame
                 n = 0
+                deferred: list = []
                 while body is not None:
                     n += 1
                     recorder.frame(conn_id, "in", body)
@@ -441,18 +443,32 @@ class Gateway:
                                  "message": "unexpected binary frame"})
                     else:
                         frame = json.loads(body.decode())
-                        try:
-                            await session.handle(frame)
-                        except (RuntimeError, ConnectionError) as e:
-                            # a core error reply (auth refusal, storage
-                            # failure) answers THIS request — it must
-                            # not kill the socket
-                            session.push(
-                                {"t": "error", "rid": frame.get("rid"),
-                                 "message": str(e)})
+                        if frame.get("t") in _BULK_FRAMES:
+                            # lane priority (mirrors the core's
+                            # _handle_conn): bulk backfill relays run
+                            # after the wave's interactive frames
+                            deferred.append(frame)
+                        else:
+                            try:
+                                await session.handle(frame)
+                            except (RuntimeError, ConnectionError) as e:
+                                # a core error reply (auth refusal,
+                                # storage failure) answers THIS request
+                                # — it must not kill the socket
+                                session.push(
+                                    {"t": "error",
+                                     "rid": frame.get("rid"),
+                                     "message": str(e)})
                     body = None
                     if n < 64 and _frame_buffered(reader):
                         body = await _read_body(reader)
+                for frame in deferred:
+                    try:
+                        await session.handle(frame)
+                    except (RuntimeError, ConnectionError) as e:
+                        session.push({"t": "error",
+                                      "rid": frame.get("rid"),
+                                      "message": str(e)})
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass

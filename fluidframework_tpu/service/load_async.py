@@ -30,9 +30,21 @@ from collections import defaultdict
 from typing import Optional
 
 from ..protocol import binwire
-from ..protocol.messages import TraceHop
+from ..protocol.messages import DocumentMessage, MessageType, TraceHop
 from ..utils.telemetry import HOP_ACK, HOP_SUBMIT, hop_pairs
 from .synthetic import SyntheticEditor
+
+
+def _op_from_fields(d: dict) -> DocumentMessage:
+    """Rebuild a DocumentMessage from the nack's echoed op fields (the
+    wire dict has no _kind discriminator; traces are dropped — a
+    resubmitted boxcar re-arms tracing itself if sampled)."""
+    return DocumentMessage(
+        client_sequence_number=d["client_sequence_number"],
+        reference_sequence_number=d["reference_sequence_number"],
+        type=MessageType(d["type"]),
+        contents=d.get("contents"),
+        metadata=d.get("metadata"))
 
 
 class _AsyncClient:
@@ -60,6 +72,14 @@ class _AsyncClient:
         self.lat_ms: list[float] = []
         self.acked = 0
         self.submitted = 0
+        self.nacked = 0
+        # admission-shed retry state: shed nacks echo the op back with
+        # retry_after_ms; held here (keyed by cseq so resubmission can
+        # restore clientSeq order) until the jittered deadline
+        self.shed = 0
+        self._rng = rng
+        self._shed_ops: dict[int, dict] = {}
+        self._resubmit_at: Optional[float] = None
         # per-hop splits: the two-leg deli split from the record's deli
         # stamp, or the full hoptail breakdown on sampled cols frames
         self.hops: dict[str, list] = defaultdict(list)
@@ -94,9 +114,50 @@ class _AsyncClient:
         frame = json.loads(body.decode())
         if frame.get("t") == "connected":
             self.client_id = frame["clientId"]
+        elif frame.get("t") == "nack":
+            self._on_nack(frame.get("nack") or {})
         elif frame.get("t") == "error":
             raise RuntimeError(frame.get("message"))
         return frame
+
+    def _on_nack(self, d: dict) -> None:
+        retry_ms = d.get("retry_after_ms")
+        op = d.get("operation")
+        if not retry_ms or op is None:
+            self.nacked += 1
+            return
+        # shed: honor the server's backoff with jitter, then resubmit.
+        # The pending t0 stays untouched — the sampled latency includes
+        # the backoff, which is exactly what an overloaded user feels.
+        self.shed += 1
+        self._shed_ops[op["client_sequence_number"]] = op
+        self._resubmit_at = max(
+            self._resubmit_at or 0.0,
+            time.perf_counter()
+            + (retry_ms / 1000.0) * (1.0 + 0.5 * self._rng.random()))
+
+    async def shed_flush_loop(self) -> None:
+        """Resubmit shed ops (cseq order) once their deadline passes;
+        a re-shed just lands them back here with a fresh deadline."""
+        try:
+            while True:
+                await asyncio.sleep(0.02)
+                if not self._shed_ops:
+                    continue
+                at = self._resubmit_at
+                if at is not None and time.perf_counter() < at:
+                    continue
+                items = sorted(self._shed_ops.items())
+                self._shed_ops = {}
+                self._resubmit_at = None
+                ops = [_op_from_fields(d) for _, d in items]
+                body = binwire.encode_submit_columns(ops)
+                if body is None:
+                    body = binwire.encode_submit(ops)
+                self.writer.write(binwire.frame(body))
+                await self.writer.drain()
+        except (asyncio.CancelledError, ConnectionResetError, OSError):
+            pass
 
     def _observe(self, body: bytes) -> None:
         """Track a broadcast via the lazy scan — no message objects.
@@ -219,6 +280,8 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
 
     await asyncio.gather(*(staged_connect(c) for c in clients))
     readers = [asyncio.ensure_future(c.read_loop()) for c in clients]
+    shed_flushers = [asyncio.ensure_future(c.shed_flush_loop())
+                     for c in clients]
 
     late_s = 0.0
     if start_at is not None:
@@ -252,6 +315,8 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
     hops = dict(hops)
     for r in readers:
         r.cancel()
+    for f in shed_flushers:
+        f.cancel()
     for c in clients:
         c.close()
     return {
@@ -260,6 +325,7 @@ async def run_load(host: str, port: int, n_docs: int, clients_per_doc: int,
         "seconds": seconds,
         "lat_ms": lat,
         "hops": hops,
+        "shed": sum(c.shed for c in clients),
         "errors": [c.error for c in clients if c.error],
         "late_s": round(late_s, 1),
     }
@@ -281,6 +347,9 @@ def main() -> None:
                    help="boxcar rounds per second per client")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--doc-prefix", default="netdoc")
+    p.add_argument("--tenant", default="bench")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="ack-wait ceiling after the rounds complete")
     p.add_argument("--start-at", type=float, default=None,
                    help="wall-clock epoch at which to start submitting")
     p.add_argument("--trace-sample-n", type=int, default=16,
@@ -298,6 +367,7 @@ def main() -> None:
     result = asyncio.run(run_load(
         args.host, args.port, args.docs, args.clients_per_doc,
         args.rounds, args.batch, args.rate, args.seed, args.doc_prefix,
+        tenant=args.tenant, timeout=args.timeout,
         start_at=args.start_at, trace_sample_n=args.trace_sample_n))
     json.dump(result, sys.stdout)
     print()

@@ -65,9 +65,31 @@ class TenantManager:
 
     def __init__(self):
         self._secrets: dict[str, str] = {}
+        # admission budgets, tenant -> (ops_per_s, burst). Deliberately
+        # separate from _secrets: a rate cap must not flip `enforcing`
+        # (auth) on, and the shard-host tenants.json sync persists
+        # secrets only.
+        self._rates: dict[str, tuple[float, float]] = {}
 
     def register(self, tenant_id: str, secret: str) -> None:
         self._secrets[tenant_id] = secret
+
+    def set_rate(self, tenant_id: str, ops_per_s: float,
+                 burst: Optional[float] = None) -> None:
+        """Cap a tenant's admission rate; ``ops_per_s <= 0`` clears it.
+
+        Tenants without a rate stay unlimited (the default), so
+        configuring one noisy tenant never touches the rest."""
+        if ops_per_s <= 0:
+            self._rates.pop(tenant_id, None)
+            return
+        self._rates[tenant_id] = (
+            float(ops_per_s),
+            float(burst) if burst is not None else max(float(ops_per_s), 1.0))
+
+    def rate_for(self, tenant_id: str) -> Optional[tuple[float, float]]:
+        """(ops_per_s, burst) for the tenant, or None = unlimited."""
+        return self._rates.get(tenant_id)
 
     def remove(self, tenant_id: str) -> bool:
         """Deregister a tenant; its tokens stop validating immediately."""

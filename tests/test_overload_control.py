@@ -1,0 +1,383 @@
+"""Overload control loop: windowed series, SLO engine, admission gate,
+and the driver's transparent shed-retry lane end to end.
+
+Ref: server/routerlicious throttling middleware (Alfred's per-tenant
+throttler) is the analog; our admission decision lives in
+service/admission.py and the closed loop is ours (ARCHITECTURE.md
+"Overload control").
+"""
+
+import time
+
+import pytest
+
+from fluidframework_tpu.obs.metrics import (
+    MetricsRegistry,
+    WindowedSeries,
+    _Series,
+    parse_prometheus,
+)
+from fluidframework_tpu.obs.slo import (
+    STATE_OK,
+    STATE_VIOLATED,
+    STATE_WARN,
+    SloEngine,
+    SloSpec,
+    parse_slo_spec,
+)
+from fluidframework_tpu.service.admission import (
+    RETRY_AFTER_MAX_MS,
+    RETRY_AFTER_MIN_MS,
+    AdmissionController,
+    TokenBucket,
+    retry_after_ms,
+)
+
+
+def wait_for(pred, timeout: float = 20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+# ------------------------------------------------------ windowed series
+
+
+def test_windowed_series_rotation_and_expiry():
+    """A bucket's epoch going stale resets it in place (lazy rotation);
+    reads merge only buckets still inside the window."""
+    ws = WindowedSeries(window_s=10.0, buckets=10)  # 1s buckets
+    ws.observe(1.0, now=100.0)
+    ws.observe(2.0, now=100.5)  # same bucket
+    ws.observe(3.0, now=105.0)
+    count, merged = ws.stats(now=105.0)
+    assert count == 3 and sorted(merged) == [1.0, 2.0, 3.0]
+    # 11s later the epoch-100 bucket is outside the window
+    count, merged = ws.stats(now=111.0)
+    assert count == 1 and merged == [3.0]
+    # writing into the recycled slot resets it rather than accumulating
+    ws.observe(9.0, now=110.0)  # epoch 110 -> slot 0, was epoch 100
+    count, merged = ws.stats(now=110.9)
+    assert count == 2 and sorted(merged) == [3.0, 9.0]
+    # a narrower read window trims to the trailing seconds
+    count, merged = ws.stats(now=110.9, window_s=1.0)
+    assert count == 1 and merged == [9.0]
+
+
+def test_windowed_series_reservoir_keeps_true_count():
+    ws = WindowedSeries(window_s=10.0, buckets=10, max_per_bucket=16)
+    for i in range(1000):
+        ws.observe(float(i), now=200.0)
+    count, merged = ws.stats(now=200.0)
+    assert count == 1000 and len(merged) == 16
+    # reservoir samples the whole stream, not just the first 16
+    assert max(merged) > 15.0
+
+
+def test_windowed_quantile_empty_is_zero():
+    ws = WindowedSeries()
+    assert ws.quantile(0.99, now=5.0) == 0.0
+
+
+def test_window_stats_label_subset_merges_tenants():
+    """A pair-only filter merges every tenant's series of that pair —
+    the SLO engine's untenanted specs read the whole pair."""
+    reg = MetricsRegistry()
+    reg.observe_windowed("obs.hop.window_ms", 5.0, now=50.0,
+                         pair="submit_to_admit", tenant="a")
+    reg.observe_windowed("obs.hop.window_ms", 7.0, now=50.0,
+                         pair="submit_to_admit", tenant="b")
+    reg.observe_windowed("obs.hop.window_ms", 9.0, now=50.0,
+                         pair="admit_to_deli")
+    count, q = reg.window_stats("obs.hop.window_ms", now=50.0,
+                                pair="submit_to_admit")
+    assert count == 2 and q[0.99] == 7.0
+    count, q = reg.window_stats("obs.hop.window_ms", now=50.0,
+                                pair="submit_to_admit", tenant="a")
+    assert count == 1 and q[0.99] == 5.0
+    # windowed series render into the scrape as summary families
+    series = parse_prometheus(reg.scrape())
+    assert (("pair", "submit_to_admit"), ("tenant", "a")) in \
+        series["fluid_obs_hop_window_ms_count"]
+
+
+def test_series_reservoir_admits_late_samples():
+    """Past the sample cap the reservoir keeps replacing — lifetime
+    quantiles represent the whole stream, not the first 4096 values."""
+    s = _Series()
+    for _ in range(4096):
+        s.add(0.0)
+    for _ in range(4096):
+        s.add(1.0)
+    assert s.count == 8192 and len(s.samples) == 4096
+    late = sum(1 for v in s.samples if v == 1.0)
+    # uniform reservoir: expect ~half; anything >0 proves replacement,
+    # the wide band keeps the (seeded, deterministic) check honest
+    assert 1000 < late < 3000
+
+
+# -------------------------------------------------- prometheus escaping
+
+
+def test_prometheus_hostile_label_roundtrip():
+    reg = MetricsRegistry()
+    evil = 'ten"ant\\with\nnewline'
+    reg.inc("net.admission.shed", 4, tenant=evil, reason="rate")
+    text = reg.scrape()
+    assert "\n\n" not in text  # the raw newline did not split the line
+    series = parse_prometheus(text)
+    key = (("reason", "rate"), ("tenant", evil))
+    assert series["fluid_net_admission_shed"][key] == 4
+
+
+# ------------------------------------------------------------ slo specs
+
+
+def test_parse_slo_spec_forms():
+    s = parse_slo_spec("ingest=submit_to_admit:25:5:3")
+    assert (s.name, s.pair, s.p99_budget_ms, s.window_s, s.burn_ticks) \
+        == ("ingest", "submit_to_admit", 25.0, 5.0, 3)
+    assert s.tenant is None
+    t = parse_slo_spec("vip=submit_to_admit@acme:10")
+    assert t.tenant == "acme" and t.window_s == 10.0 and t.burn_ticks == 2
+    for bad in ("noequals", "a=pair", "a=pair:NaNish:x"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+def test_slo_state_machine_frozen_clock(tmp_path):
+    """ok -> warn on the first over-budget tick, violated after
+    burn_ticks consecutive, back to ok on recovery; the violations
+    counter and flight dump fire only on the ok->violated transition."""
+    from fluidframework_tpu.obs import FlightRecorder
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    spec = SloSpec(name="ingest", pair="submit_to_admit",
+                   p99_budget_ms=10.0, window_s=10.0, burn_ticks=2,
+                   min_count=2)
+    eng = SloEngine([spec], registry=reg, recorder=rec)
+
+    def tick(now):
+        eng.evaluate(now=now)
+        return eng._state["ingest"]
+
+    # under min_count: one hot sample is noise
+    reg.observe_windowed("obs.hop.window_ms", 500.0, now=100.0,
+                         pair="submit_to_admit")
+    assert tick(100.0) == STATE_OK and not eng.shed_signal
+    # sustained burn: warn, then violated
+    reg.observe_windowed("obs.hop.window_ms", 400.0, now=100.2,
+                         pair="submit_to_admit")
+    assert tick(100.5) == STATE_WARN and not eng.shed_signal
+    assert tick(101.0) == STATE_VIOLATED
+    assert eng.shed_signal and "submit_to_admit" in eng.violated_pairs
+    # staying violated does not re-count or re-dump
+    assert tick(101.5) == STATE_VIOLATED
+    series = parse_prometheus(reg.scrape())
+    assert series["fluid_obs_slo_violations"][(("slo", "ingest"),)] == 1
+    assert series["fluid_obs_slo_state"][(("slo", "ingest"),)] == 2
+    assert rec.last_dump is not None
+    # recovery: the window drains 11s later
+    assert tick(112.0) == STATE_OK
+    assert not eng.shed_signal and not eng.violated_pairs
+    row = eng.status()[0]
+    assert row["state"] == "ok" and row["burn"] == 0
+
+
+# --------------------------------------------------------- token bucket
+
+
+def test_token_bucket_deterministic_refill():
+    b = TokenBucket(rate=10.0, burst=20.0)
+    assert b.take(20, now=0.0) == 0.0          # full burst affordable
+    assert b.take(5, now=0.0) == 0.5           # 5 short at 10/s
+    assert b.tokens == 0.0                     # failed take leaves tokens
+    assert b.take(5, now=0.5) == 0.0           # refilled exactly 5
+    assert b.take(1, now=0.5) == pytest.approx(0.1)
+    b2 = TokenBucket(rate=10.0, burst=20.0)
+    assert b2.take(20, now=1000.0) == 0.0      # start time irrelevant
+    assert b2.take(3, now=1000.2) == pytest.approx(0.1)
+
+
+def test_token_bucket_oversize_admits_when_full():
+    """A boxcar larger than burst admits once the bucket is FULL, going
+    negative (refill pays the debt) — refusing it outright would
+    livelock the driver's coalesced shed-backlog resubmit forever."""
+    b = TokenBucket(rate=100.0, burst=50.0)
+    assert b.take(500, now=0.0) == 0.0
+    assert b.tokens == -450.0
+    # in debt: even one token is refused until the refill catches up
+    assert b.take(1, now=1.0) > 0.0            # tokens = -350
+    assert b.take(1, now=5.0) == 0.0           # refilled to burst cap
+    # partially full is NOT full: the oversize rule needs tokens==burst
+    b3 = TokenBucket(rate=100.0, burst=50.0)
+    b3.take(20, now=0.0)
+    wait = b3.take(500, now=0.0)
+    assert wait == pytest.approx(470 / 100.0)
+
+
+def test_retry_after_clamp():
+    assert retry_after_ms(0.001) == RETRY_AFTER_MIN_MS
+    assert retry_after_ms(0.4) == 400
+    assert retry_after_ms(99.0) == RETRY_AFTER_MAX_MS
+
+
+# ------------------------------------------------- admission controller
+
+
+class _FakeConn:
+    def __init__(self, tenant):
+        self.tenant_id = tenant
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.shed_signal = False
+
+
+def test_admission_soft_admit_vs_shed():
+    """Depletion alone only soft-admits (accounting, not refusal);
+    depletion DURING an SLO burn sheds with a bounded retry-after."""
+    reg = MetricsRegistry()
+    eng = _FakeEngine()
+    adm = AdmissionController(lambda t: (100.0, 10.0), registry=reg)
+    adm.engine = eng
+    conn = _FakeConn("acme")
+    assert adm.check(conn, 10, 1, now=0.0) == 0.0
+    # depleted + healthy SLOs: admitted anyway, overage accounted
+    assert adm.check(conn, 10, 11, now=0.0) == 0.0
+    series = parse_prometheus(reg.scrape())
+    assert series["fluid_net_admission_delayed"][(("tenant", "acme"),)] \
+        == 10
+    # depleted + burning: the whole boxcar sheds
+    eng.shed_signal = True
+    wait = adm.check(conn, 10, 21, now=0.0)
+    assert wait > 0.0
+    series = parse_prometheus(reg.scrape())
+    key = (("reason", "rate"), ("tenant", "acme"))
+    assert series["fluid_net_admission_shed"][key] == 10
+    # master switch off (bench control arm): back to soft-admit
+    adm.shedding = False
+    conn2 = _FakeConn("acme")
+    assert adm.check(conn2, 999, 1, now=100.0) == 0.0
+
+
+def test_admission_unlimited_tenant_never_gated():
+    adm = AdmissionController(lambda t: None, registry=MetricsRegistry())
+    adm.engine = _FakeEngine()
+    adm.engine.shed_signal = True
+    assert adm.check(_FakeConn("free"), 10 ** 6, 1, now=0.0) == 0.0
+
+
+def test_admission_ordering_watermark():
+    """Once cseq N shed, later cseqs shed too (reason=ordering) until
+    the client rewinds to N — admitting them would gap clientSeq at
+    deli."""
+    reg = MetricsRegistry()
+    eng = _FakeEngine()
+    eng.shed_signal = True
+    adm = AdmissionController(lambda t: (100.0, 10.0), registry=reg)
+    adm.engine = eng
+    conn = _FakeConn("acme")
+    assert adm.check(conn, 10, 1, now=0.0) == 0.0   # burst spent
+    assert adm.check(conn, 5, 11, now=0.0) > 0.0    # shed; resume=11
+    assert conn._shed_resume == 11
+    # ops behind the watermark shed regardless of bucket state
+    assert adm.check(conn, 5, 16, now=50.0) > 0.0
+    series = parse_prometheus(reg.scrape())
+    key = (("reason", "ordering"), ("tenant", "acme"))
+    assert series["fluid_net_admission_shed"][key] == 5
+    # the rewind (resubmit from cseq 11) clears the watermark and,
+    # with the bucket refilled, admits
+    assert adm.check(conn, 10, 11, now=50.0) == 0.0
+    assert conn._shed_resume is None
+
+
+# --------------------------------------------- shed/backoff end to end
+
+
+@pytest.mark.parametrize("lane", ["columnar", "rec"])
+def test_shed_retry_contract_end_to_end(lane):
+    """A rated tenant overruns its bucket during an armed SLO burn: the
+    server sheds with retry_after_ms, the driver transparently backs
+    off and resubmits, and EVERY op is eventually acked with its
+    payload intact — no app-visible nack, on both wire lanes."""
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+        TraceHop,
+    )
+    from fluidframework_tpu.service.front_end import NetworkFrontEnd
+    from fluidframework_tpu.service.local_server import LocalServer
+    from fluidframework_tpu.service.tenants import TenantManager
+
+    tm = TenantManager()
+    tm.set_rate("t", 50.0, burst=50.0)
+    front = NetworkFrontEnd(LocalServer(tenants=tm)).start_background()
+    engine = SloEngine([SloSpec(
+        name="trigger", pair="submit_to_admit", p99_budget_ms=0.0,
+        burn_ticks=1, min_count=1)])
+    front.attach_slo(engine)
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front.port)
+    try:
+        conn = factory.create_document_service(
+            "t", f"shed-{lane}").connect_to_delta_stream()
+        conn.trace_sample_n = 1
+        acked = {}
+        hard = []
+        conn.on_op = lambda m: (
+            m.client_id == conn.client_id
+            and acked.__setitem__(m.client_sequence_number, m))
+        conn.on_nack = lambda m: hard.append(m)
+
+        def op(cseq):
+            if lane == "columnar":
+                contents = {"kind": "chanop", "address": "default",
+                            "contents": {"address": "text",
+                                         "contents": {"type": 0, "pos": 0,
+                                                      "text": "x"}}}
+                traces = []
+            else:
+                contents = {"free": "form", "cseq": cseq}
+                # rec lane: the client stamp rides a TraceHop record
+                traces = [TraceHop("client", "submit", time.time())]
+            return DocumentMessage(
+                client_sequence_number=cseq,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION, contents=contents,
+                traces=traces)
+
+        # prime inside the budget, then arm the hair-trigger SLO
+        conn.submit([op(c) for c in (1, 2)])
+        assert wait_for(lambda: len(acked) == 2)
+        engine.evaluate()
+        assert engine.shed_signal
+        # overrun: burst is long spent, so this boxcar sheds
+        conn.submit([op(c) for c in range(3, 103)])
+        snap = factory.counters.snapshot
+        assert wait_for(
+            lambda: snap().get("driver.submit.shed_retries", 0) > 0)
+        # ...and the retry lane converges without clearing the burn
+        # (bucket refill + full-bucket oversize admission)
+        assert wait_for(lambda: len(acked) == 102, timeout=30.0)
+        assert not hard, f"hard nack leaked: {hard[0]}"
+        if lane == "rec":
+            assert acked[50].contents == {"free": "form", "cseq": 50}
+        else:
+            assert acked[50].contents["contents"]["address"] == "text"
+        from fluidframework_tpu.obs import get_registry
+
+        shed = parse_prometheus(get_registry().scrape()).get(
+            "fluid_net_admission_shed", {})
+        assert sum(shed.values()) > 0
+        conn.close()
+    finally:
+        engine.stop()
+        front.stop()

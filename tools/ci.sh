@@ -32,6 +32,9 @@ if [ "$run_soak" = 1 ]; then
     echo "--- chaos soak (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.soak --seed 0 --quick
     echo "soak: ok"
+    echo "--- noisy-neighbor overload scenario (fixed seed, quick)"
+    python -m fluidframework_tpu.chaos.noisy --seed 0 --quick
+    echo "noisy: ok"
 fi
 
 echo "ci: all gates passed"

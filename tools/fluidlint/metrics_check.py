@@ -2,14 +2,21 @@
 
 Two checks over the library package:
 
-- **metric names**: every string-literal first argument to ``.inc(...)``
-  or ``.observe(...)`` (Counters or MetricsRegistry, same surface) must
-  be dotted lowercase with 3–4 segments — ``driver.submit.coalesced``,
+- **metric names**: every string-literal first argument to ``.inc(...)``,
+  ``.observe(...)``, ``.set_gauge(...)`` or ``.observe_windowed(...)``
+  (Counters or MetricsRegistry, same surface) must be dotted lowercase
+  with 3–4 segments — ``driver.submit.coalesced``,
   ``chaos.recovered.orderer_restart``. A scrape namespace where half the
   names are ``opsDone`` and half are ``driver.ops.done`` cannot be
   queried; the convention is only worth having if it is total. F-strings
   and computed names are skipped (the detailed per-point chaos counters
   compose their suffix at runtime).
+- **locked families**: the ``obs.slo.*`` and ``net.admission.*``
+  namespaces are alert-surface contracts — dashboards and the overload
+  bench key on the exact member set. A new name under a locked prefix
+  must be added to :data:`LOCKED_FAMILIES` here in the same change, or
+  the lint refuses it (spelling drift like ``net.admission.dropped`` vs
+  the canonical ``net.admission.shed`` is exactly the bug this catches).
 - **Counters construction**: ``Counters(...)`` may only be constructed
   in ``utils/telemetry.py`` (its home) and ``obs/metrics.py`` (the
   registry factory). Everywhere else must go through
@@ -40,7 +47,17 @@ COUNTERS_HOMES = (
 #: dotted lowercase, 3–4 segments: tier.noun.verb(.qualifier)
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+){2,3}$")
 
-_METHODS = ("inc", "observe")
+_METHODS = ("inc", "observe", "set_gauge", "observe_windowed")
+
+#: prefix -> exact member set. These families are overload-control
+#: alert surfaces (SLO dashboards, the overload bench's gates, the
+#: noisy-neighbor scenario); a name under one of these prefixes that
+#: is not in the set is either a typo or an unreviewed contract change.
+LOCKED_FAMILIES = {
+    "obs.slo.": frozenset({"obs.slo.state", "obs.slo.violations"}),
+    "net.admission.": frozenset({"net.admission.shed",
+                                 "net.admission.delayed"}),
+}
 
 
 def _py_files(root: str) -> Iterable[str]:
@@ -82,6 +99,22 @@ def check_file(path: str, repo_root: Optional[str] = None
                                 "segments)",
                         suggestion="rename to e.g. "
                                    '"driver.submit.coalesced"'))
+                else:
+                    for prefix, members in LOCKED_FAMILIES.items():
+                        if name.startswith(prefix) and name not in members:
+                            out.append(Violation(
+                                pass_name="metric-name", path=rel,
+                                line=node.lineno,
+                                message=f'"{name}" is not a registered '
+                                        f"member of the locked "
+                                        f'"{prefix}*" family '
+                                        f"(members: "
+                                        f"{', '.join(sorted(members))})",
+                                suggestion="add it to LOCKED_FAMILIES in "
+                                           "tools/fluidlint/"
+                                           "metrics_check.py if the "
+                                           "contract change is "
+                                           "intentional"))
         if (isinstance(func, ast.Name) and func.id == "Counters"
                 and rel not in COUNTERS_HOMES):
             out.append(Violation(

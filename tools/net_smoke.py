@@ -20,7 +20,11 @@ amortization points of the socket tier (see ARCHITECTURE.md
 - a catch-up client backfilling the full range through the columnar
   door — the sequenced stream must have ridden the segment lane
   (``storage.segment.appends``) and the server must have served raw
-  block byte ranges (``storage.backfill.byterange``).
+  block byte ranges (``storage.backfill.byterange``);
+- a mini-overload burst with the admission gate + a hair-trigger SLO
+  armed — ``net.admission.shed`` must rise, ``obs.slo.state`` must
+  appear in the scrape, and the driver's transparent shed retries must
+  converge once shedding is disarmed.
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -197,6 +201,72 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    # mini-overload burst: arm the admission gate + a hair-trigger SLO
+    # (p99 budget 0 on submit_to_admit, manual tick — no ticker race),
+    # deplete the smoke tenant's bucket, and prove the loop closes:
+    # sheds counted, SLO state in the scrape, and the driver's
+    # transparent retries converging once shedding is disarmed
+    from fluidframework_tpu.obs import get_registry
+    from fluidframework_tpu.obs.slo import SloEngine, SloSpec
+    from fluidframework_tpu.service.tenants import TenantManager
+
+    tm = TenantManager()
+    tm.set_rate("smoke", 25, burst=200)
+    front.server.tenants = tm
+    engine = SloEngine([SloSpec(
+        name="smoke_admit", pair="submit_to_admit", p99_budget_ms=0.0,
+        burn_ticks=1, min_count=1)])
+    front.attach_slo(engine)
+    base = N_OPS + N_COLS
+    for i in range(2):  # fresh traced boxcars keep the window live
+        conn1.submit([chan_op(base + i + 1, i)])
+    base += 2
+    if not wait_for(lambda: delivered(seen1, conn1.client_id, base)):
+        print("net_smoke: FAIL — pre-overload ops did not converge",
+              file=sys.stderr)
+        return 1
+    engine.evaluate()
+    if not engine.shed_signal:
+        print("net_smoke: FAIL — hair-trigger SLO never armed shedding",
+              file=sys.stderr)
+        return 1
+    # one full-budget boxcar empties the bucket (burst tokens)...
+    conn1.submit([chan_op(base + i + 1, i) for i in range(200)])
+    base += 200
+    if not wait_for(lambda: delivered(seen1, conn1.client_id, base)):
+        print("net_smoke: FAIL — bucket-depleting boxcar did not "
+              "converge", file=sys.stderr)
+        return 1
+    # ...so the next burst finds it depleted, the SLO violated, and
+    # sheds through the nack door
+    conn1.submit([chan_op(base + i + 1, i) for i in range(100)])
+    base += 100
+    reg = get_registry()
+
+    def shed_count() -> float:
+        series = parse_prometheus(reg.scrape())
+        return sum(series.get("fluid_net_admission_shed", {}).values())
+
+    if not wait_for(lambda: shed_count() > 0, timeout=10.0):
+        print("net_smoke: FAIL — overload burst never shed "
+              "(net.admission.shed stayed 0)", file=sys.stderr)
+        return 1
+    s.sendall(_frame({"t": "admin_metrics_scrape", "rid": 3}))
+    reply = read_frame()
+    while reply.get("rid") != 3:
+        reply = read_frame()
+    overload_series = parse_prometheus(reply["scrape"])
+    if "fluid_obs_slo_state" not in overload_series:
+        print("net_smoke: FAIL — obs.slo.state missing from the scrape",
+              file=sys.stderr)
+        return 1
+    # disarm shedding: the held ops soft-admit on the driver's retry
+    front.admission.shedding = False
+    if not wait_for(lambda: delivered(seen1, conn1.client_id, base)):
+        print("net_smoke: FAIL — shed retries never converged after "
+              f"disarm ({len(seen1)} of {base})", file=sys.stderr)
+        return 1
+
     drv = factory.counters.snapshot()
     srv = front.counters.snapshot()
     sto = log.counters.snapshot()
@@ -211,6 +281,10 @@ def main() -> int:
         "storage.segment.appends": sto.get("storage.segment.appends", 0),
         "storage.backfill.byterange": sto.get(
             "storage.backfill.byterange", 0),
+        "net.admission.shed": int(sum(
+            overload_series.get("fluid_net_admission_shed", {}).values())),
+        "driver.submit.shed_retries": drv.get(
+            "driver.submit.shed_retries", 0),
     }
     frames = drv.get("driver.submit.frames", 0)
     ops = drv.get("driver.submit.ops", 0)
