@@ -812,6 +812,202 @@ def bench_overload_sweep(knee: dict) -> dict:
     }
 
 
+def _admin_rpc(port: int, frame: dict, timeout: float = 30.0) -> dict:
+    """One rid-matched admin RPC round trip against a front-end process.
+
+    The timeout is the caller's to size: ``admin_summarize`` replies only
+    after the server's host replica has ingested the whole log tail and
+    committed the version, which on a 100k-op doc is tens of seconds."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        body = json.dumps(dict(frame, rid=1)).encode()
+        s.sendall(len(body).to_bytes(4, "big") + body)
+
+        def read_exactly(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("closed")
+                buf += chunk
+            return buf
+
+        while True:
+            n = int.from_bytes(read_exactly(4), "big")
+            reply = json.loads(read_exactly(n).decode())
+            if reply.get("rid") != 1:
+                continue
+            if reply.get("t") == "error":
+                raise RuntimeError(reply.get("message"))
+            return reply
+
+
+def bench_join_storm() -> dict:
+    """Late-joiner catch-up on a long-lived doc: snapshot+Δ vs replay.
+
+    ONE front-end process, ONE doc carrying ≥ 100k sequenced ops at the
+    config-4 per-doc geometry (10 synthetic socket clients). Three
+    measurements, ordered so each boot shape is forced honestly:
+
+    - **whole-log replay**: cold Loader boots BEFORE any summary exists
+      — every op replays through the client merge-tree (the
+      pre-snapshot-plane catch-up cost, O(whole log));
+    - **snapshot+Δ storm**: after ONE service summary (the
+      ``admin_summarize`` door onto the summarizer), a storm of cold
+      joiners — each with a fresh driver cache — boots while a trickle
+      writer keeps the stream moving, so every time-to-interactive is
+      a true MID-STREAM join: snapshot fetch + bounded Δ backfill;
+    - **counter assertions** (in-bench, hard): the server frames the
+      snapshot exactly ONCE for the whole storm (per-join re-encodes
+      == 0), no joiner falls back to the legacy tree shim, and every
+      joiner's backfill was snapshot-bounded. A storm that silently
+      rode the JSON tree path would otherwise publish a plausible
+      number that measures the wrong plane.
+    """
+    import subprocess
+    import time as _time
+
+    from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.obs import tier_counters
+
+    doc = "jstorm0"
+    # ONE explicit Counters handed to every factory: tier_counters vends
+    # a fresh instance per call, so per-boot deltas are only observable
+    # through a shared instance
+    drv = tier_counters("driver")
+
+    def boot(label):
+        """Cold boot: fresh factory (empty snapshot/chunk cache), timed
+        resolve → the doc is interactive (caught up + channel readable)."""
+        factory = NetworkDocumentServiceFactory("127.0.0.1", port,
+                                                counters=drv)
+        t0 = _time.perf_counter()
+        c = Loader(factory).resolve("bench", doc)
+        # interactive = the channel answers from converged state
+        assert len(c.runtime.get_data_store("default")
+                   .get_channel("text").get_text()) > 0, label
+        dt = _time.perf_counter() - t0
+        c.close()
+        return dt
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3) if vals else None
+
+    fe, port = _spawn_listening(
+        "fluidframework_tpu.service.front_end", "--port", "0")
+    trickle = None
+    try:
+        # attach the doc with a real writer (raw synthetic clients never
+        # send the attach op a booting runtime needs to route chanops)
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", port)).resolve("bench", doc)
+        ss = writer.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        ss.insert_text(0, "join-storm seed ")
+        deadline = _time.time() + 30
+        while writer.runtime.pending.count and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert writer.runtime.pending.count == 0, "writer never quiesced"
+        writer.close()
+
+        # the long-lived doc: 10 clients × 320 rounds × 32-op boxcars
+        # = 102,400 ops on one stream (config-4 per-doc geometry)
+        w = subprocess.Popen(
+            _lean_cmd("fluidframework_tpu.service.load_async",
+                      "--port", str(port), "--docs", "1",
+                      "--clients-per-doc", "10", "--rounds", "320",
+                      "--batch", "32", "--rate", "8", "--seed", "7",
+                      "--doc-prefix", "jstorm", "--timeout", "300"),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=REPO, env=_lean_env())
+        res = json.loads(w.communicate(timeout=900)[0])
+        doc_ops = res["acked"]
+        assert doc_ops >= 100_000, f"doc too short: {doc_ops} acked"
+
+        # A: whole-log replay (no summary committed yet, so the columnar
+        # door reports "no version" and the boot replays from seq 0)
+        pre = drv.snapshot()
+        replay_s = [round(boot(f"replay{i}"), 3) for i in range(2)]
+        d = drv.snapshot()
+        assert d.get("boot.backfill.full", 0) \
+            - pre.get("boot.backfill.full", 0) == 2, \
+            "replay boots were not whole-log"
+
+        # ONE service summary through the operator door
+        version = _admin_rpc(
+            port, {"t": "admin_summarize", "tenant": "bench", "doc": doc},
+            timeout=600.0)["version"]
+
+        # trickle writer: the stream keeps moving, so every storm boot
+        # is a mid-stream join with a real post-snapshot Δ to backfill
+        trickle = subprocess.Popen(
+            _lean_cmd("fluidframework_tpu.service.load_async",
+                      "--port", str(port), "--docs", "1",
+                      "--clients-per-doc", "2", "--rounds", "400",
+                      "--batch", "8", "--rate", "2", "--seed", "11",
+                      "--doc-prefix", "jstorm", "--timeout", "60"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=REPO, env=_lean_env())
+        _time.sleep(1.0)
+
+        # B: the storm — cold snapshot+Δ boots
+        joins = 8
+        pre_srv = _query_counters(port)
+        pre_drv = drv.snapshot()
+        tti = [round(boot(f"join{i}"), 3) for i in range(joins)]
+        post_srv = _query_counters(port)
+        post_drv = drv.snapshot()
+
+        def delta(post, pre, name):
+            return post.get(name, 0) - pre.get(name, 0)
+
+        encodes = delta(post_srv, pre_srv, "storage.snapshot.encodes")
+        reencodes = encodes - 1  # first serve fills the framed cache
+        assert reencodes == 0, \
+            f"snapshot re-encoded during the storm ({encodes} encodes)"
+        assert delta(post_drv, pre_drv, "boot.snapshot.fallback") == 0, \
+            "a joiner fell back to the legacy tree shim"
+        assert delta(post_drv, pre_drv, "boot.snapshot.used") == joins
+        assert delta(post_drv, pre_drv, "boot.backfill.bounded") == joins, \
+            "a joiner's backfill was not snapshot-bounded"
+
+        speedup = round(pct(replay_s, 0.5) / pct(tti, 0.5), 1)
+        assert speedup >= 10.0, \
+            f"snapshot+Δ only {speedup}x faster than whole-log replay"
+        return {
+            "doc_ops": doc_ops,
+            "joins": joins,
+            "replay_boot_s": replay_s,
+            "tti_p50_s": pct(tti, 0.5),
+            "tti_p99_s": pct(tti, 0.99),
+            "speedup_vs_replay_x": speedup,
+            "reencodes_per_join": reencodes,
+            "snapshot_version": version,
+            "counters": {
+                "storage.snapshot.encodes": encodes,
+                "storage.snapshot.served": delta(
+                    post_srv, pre_srv, "storage.snapshot.served"),
+                "storage.snapshot.cache_hits": delta(
+                    post_srv, pre_srv, "storage.snapshot.cache_hits"),
+                "storage.snapshot.legacy_tree": delta(
+                    post_srv, pre_srv, "storage.snapshot.legacy_tree"),
+                "boot.chunks.fetched": delta(
+                    post_drv, pre_drv, "boot.chunks.fetched"),
+            },
+        }
+    finally:
+        if trickle is not None:
+            trickle.terminate()
+        fe.terminate()
+        try:
+            fe.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            fe.kill()
+
+
 def bench_sharded(knee_rate: float, run_workers) -> dict:
     """The SHARDED ordering core at the knee geometry (VERDICT r4 #4):
     2 core processes over placement leases, gateways routing by doc
@@ -870,6 +1066,7 @@ def main() -> None:
     # with a TPU tunnel already saturated by the kernel/service benches
     net = bench_network()
     overload = bench_overload_sweep(net["knee"])
+    join_storm = bench_join_storm()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -959,6 +1156,12 @@ def main() -> None:
                 # through), plus the --no-shed collapse control and the
                 # caps-free armed/plain overhead pair
                 "net_overload_sweep": overload,
+                # late-joiner catch-up on a ≥100k-op doc (config-4
+                # per-doc geometry): p50/p99 time-to-interactive of a
+                # cold-join storm through the columnar snapshot plane,
+                # vs whole-log replay; encode-once counter-asserted
+                # (per-join snapshot re-encodes == 0)
+                "net_join_storm": join_storm,
             }
         )
     )

@@ -24,6 +24,7 @@ from .plane import FaultPlane
 
 def install(plane: FaultPlane, *, server=None, appliers: Iterable = (),
             stages: Iterable = (), partitions: Iterable = (),
+            fronts: Iterable = (), summarizers: Iterable = (),
             transports: bool = False) -> Callable[[], None]:
     """Arm ``plane`` at the requested seams; returns an uninstaller.
 
@@ -31,6 +32,10 @@ def install(plane: FaultPlane, *, server=None, appliers: Iterable = (),
       and, class-wide, the broadcaster fan-out (orderers build their
       BroadcasterLambda lazily, so the hook must be on the class).
     - ``appliers`` / ``stages`` / ``partitions``: instances to arm.
+    - ``fronts``: NetworkFrontEnd instances — arms the snapshot serving
+      seam (``snapshot.chunk`` torn/drop on served chunk wire bytes).
+    - ``summarizers``: ServiceSummarizer instances — arms the
+      mid-upload crash window (``snapshot.upload``).
     - ``transports=True``: arms driver/network frame delivery for every
       transport constructed while installed.
     """
@@ -54,6 +59,10 @@ def install(plane: FaultPlane, *, server=None, appliers: Iterable = (),
         _set(stage, "fault_plane", plane)
     for part in partitions:
         _set(part, "fault_plane", plane)
+    for front in fronts:
+        _set(front, "fault_plane", plane)
+    for summ in summarizers:
+        _set(summ, "fault_plane", plane)
     if transports:
         prev_hook = _network.FRAME_FAULT_HOOK
         _network.FRAME_FAULT_HOOK = plane

@@ -39,6 +39,7 @@ BOUNDARY_CLASSES = {
     "stage": "stage",
     "partition": "stage",
     "applier": "device",
+    "snapshot": "snapshot",
 }
 
 

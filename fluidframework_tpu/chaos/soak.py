@@ -15,12 +15,18 @@ fingerprint identity at quiescence:
 - **Phase B** (socket): clients drive a NetworkFrontEnd over real TCP
   while the driver transport drops / duplicates / reorders / truncates
   their submit frames mid-stream; recovery is the reconnect + rebase +
-  resubmit path.
+  resubmit path. The phase then commits a service summary under a
+  mid-upload crash (retry recovers), and boots late joiners through the
+  columnar snapshot plane while served chunk bytes arrive torn or
+  withheld — the joiners' hash checks must trip, fall back to the
+  legacy tree shim (``boot.snapshot.fallback``), and still converge to
+  the oracle fingerprint; a clean joiner must complete the columnar
+  fast boot with a bounded backfill.
 
 The run fails (exit 1) on any invariant violation, on missing boundary
-coverage (every class — network, log, fanout, stage, device — must see
-at least one injection), or when an injected fault class shows no
-matching recovery in telemetry. ``--break-dedupe`` and ``--no-recover``
+coverage (every class — network, log, fanout, stage, device, snapshot —
+must see at least one injection), or when an injected fault class shows
+no matching recovery in telemetry. ``--break-dedupe`` and ``--no-recover``
 are self-tests: each disables one recovery layer and the soak MUST fail,
 proving the monitor actually detects what the faults inject.
 """
@@ -52,7 +58,8 @@ DOC = "soak"
 DS_ID = "default"
 CHANNEL_ID = "text"
 
-BOUNDARY_REQUIRED = ("network", "log", "fanout", "stage", "device")
+BOUNDARY_REQUIRED = ("network", "log", "fanout", "stage", "device",
+                     "snapshot")
 
 _TEXT_POOL = "abcdefgh" * 4
 
@@ -83,6 +90,15 @@ def _chan_contents(m):
 def _replica_fingerprint(replica: MergeTreeClient) -> str:
     text = replica.get_text()
     props = [replica.get_properties_at(i) or {} for i in range(len(text))]
+    return doc_fingerprint(text, props)
+
+
+def _container_fingerprint(container) -> str:
+    """Fingerprint a full loader-stack container (the snapshot-booted
+    late joiners) through its shared-string channel."""
+    ss = container.runtime.get_data_store(DS_ID).get_channel(CHANNEL_ID)
+    text = ss.get_text()
+    props = [ss.client.get_properties_at(i) or {} for i in range(len(text))]
     return doc_fingerprint(text, props)
 
 
@@ -609,10 +625,14 @@ class NetSoakClient:
 
 def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
                 n_clients: int = 2) -> tuple[FaultPlane, InvariantMonitor]:
-    from ..driver.network import NetworkDocumentService
+    from ..driver.network import (NetworkDocumentService,
+                                  NetworkDocumentServiceFactory)
+    from ..loader.container import Loader
     from ..service.durable_log import DurableLog
     from ..service.front_end import NetworkFrontEnd
     from ..service.local_server import LocalServer
+    from ..service.service_summarizer import (HostReplicaSource,
+                                              ServiceSummarizer)
 
     monitor = InvariantMonitor(counters)
     plane = FaultPlane(seed + 1, counters)
@@ -643,6 +663,8 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
     monitor.attach(server.log, f"deltas/{TENANT}/{DOC}")
     front = NetworkFrontEnd(server).start_background()
     uninstall = install(plane, transports=True, server=server)
+    uninstall_snap: list = []
+    joiners: list = []
     try:
         clients = [
             NetSoakClient(
@@ -658,6 +680,59 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
                     c.reconnect()
                 c.edit(1 + rng.randrange(2))
             time.sleep(0.01)
+
+        # ---- snapshot fast-boot campaign (plane still armed) ----
+        # quiesce the stream so the summarizer's coverage gate and its
+        # replica ingest observe the same prefix
+        orderer = server._get_orderer(TENANT, DOC)
+
+        def _stream_stable():
+            s0 = orderer.deli.sequence_number
+            time.sleep(0.05)
+            return orderer.deli.sequence_number == s0
+
+        wait_for(_stream_stable, timeout=10.0)
+
+        # the first summarize dies mid-upload (chunks + version record
+        # durable, commit never ran — the version must stay invisible);
+        # recovery is a restarted summarizer redoing the pass, with the
+        # content-addressed chunk store absorbing the re-upload
+        plane.rule("snapshot.upload", "crash", every=1, times=1)
+        summarizer = ServiceSummarizer(server, HostReplicaSource(server))
+        uninstall_snap.append(install(plane, fronts=[front],
+                                      summarizers=[summarizer]))
+        version = None
+        for _ in range(5):
+            try:
+                version = summarizer.summarize_doc(TENANT, DOC)
+                break
+            except SimulatedCrash:
+                counters.inc("chaos.recovered.summary_retry")
+                summarizer = ServiceSummarizer(server,
+                                               HostReplicaSource(server))
+                uninstall_snap.append(
+                    install(plane, summarizers=[summarizer]))
+            except RuntimeError:
+                # stream advanced between gate scan and ingest: re-wait
+                wait_for(_stream_stable, timeout=10.0)
+        if version is None:
+            raise InvariantViolation(
+                "phase B never committed a service summary — the "
+                "snapshot campaign has nothing to boot from")
+
+        def _join():
+            # each joiner gets a COLD factory (fresh snapshot/chunk
+            # cache) sharing the campaign counters, so every boot pulls
+            # real chunk frames through the armed serving seam
+            factory = NetworkDocumentServiceFactory(
+                "127.0.0.1", front.port, counters=counters)
+            return Loader(factory).resolve(TENANT, DOC)
+
+        plane.rule("snapshot.chunk", "torn", every=1, times=1)
+        joiners.append(_join())   # torn wire bytes: hash check → fallback
+        plane.rule("snapshot.chunk", "drop", every=1, times=1)
+        joiners.append(_join())   # withheld chunk: hole → fallback
+        joiners.append(_join())   # clean columnar fast boot
 
         # settle: stop injecting, then resolve every open submission
         plane.disarm()
@@ -678,12 +753,42 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
                         if m.sequence_number > c.last_seq:
                             c._apply(m)
 
+        # joiners are live containers: wait until each has processed the
+        # whole sequenced stream before fingerprinting
+        for j in joiners:
+            wait_for(lambda: j.delta_manager.last_processed_seq
+                     >= server_seq)
+
         fps = {}
         for i, c in enumerate(clients):
             with c.conn.lock:
                 fps[f"net-client{i}"] = _replica_fingerprint(c.replica)
+        for i, j in enumerate(joiners):
+            fps[f"joiner{i}"] = _container_fingerprint(j)
         fps["oracle"] = _oracle_fingerprint(server)
         monitor.check_quiescent(fps)
+        snap = counters.snapshot()
+        fallbacks = snap.get("boot.snapshot.fallback", 0)
+        if fallbacks < 2:
+            raise InvariantViolation(
+                "phase B injected torn + dropped snapshot chunks but "
+                f"the boot fallback fired only {fallbacks} times — a "
+                "corrupted chunk boot went unnoticed")
+        if not snap.get("boot.snapshot.used", 0):
+            raise InvariantViolation(
+                "phase B never completed a clean columnar snapshot "
+                "boot — the fast-boot path went unexercised under "
+                "faults")
+        if not snap.get("boot.backfill.bounded", 0):
+            raise InvariantViolation(
+                "the clean snapshot boot never took the bounded "
+                "backfill — catch-up degenerated to whole-log replay")
+        fsnap = front.counters.snapshot()
+        if fsnap.get("storage.snapshot.encodes", 0) != 1:
+            raise InvariantViolation(
+                "snapshot serving re-encoded per join under faults "
+                f"(encodes={fsnap.get('storage.snapshot.encodes', 0)}"
+                ", expected the one-time framed-cache fill)")
         if monitor.observed < 10:
             raise InvariantViolation(
                 f"phase B observed only {monitor.observed} sequenced "
@@ -724,6 +829,10 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
         for c in clients:
             c.conn.close()
     finally:
+        for j in joiners:
+            j.close()
+        while uninstall_snap:
+            uninstall_snap.pop()()
         uninstall()
         front.stop()
         # Deliberately NOT server.log.close(): lingering session-close
@@ -784,6 +893,14 @@ def _cross_check(counters: Counters) -> None:
         ("chaos.injected.net.send.truncate",
          "chaos.recovered.net_reconnect"),
         ("chaos.injected.net.send.drop", "chaos.recovered.net_reconnect"),
+        # snapshot plane: a torn/withheld served chunk must trip the
+        # booting client's verify and route it down the legacy-tree
+        # fallback; a mid-upload summarizer crash must be absorbed by
+        # the restarted pass
+        ("chaos.injected.snapshot.chunk.torn", "boot.snapshot.fallback"),
+        ("chaos.injected.snapshot.chunk.drop", "boot.snapshot.fallback"),
+        ("chaos.injected.snapshot.upload.crash",
+         "chaos.recovered.summary_retry"),
     ]
     problems = []
     for injected, recovered in expectations:

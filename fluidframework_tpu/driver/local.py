@@ -129,6 +129,10 @@ class LocalStorage(DocumentStorage):
                 return None
             version = versions[0]
         ref = json.loads(self.read_blob(version["tree_id"]).decode())
+        if ref.get("t") == "snapcols":
+            from ..service.summary_trees import materialize_snapcols
+
+            return materialize_snapcols(self.read_blob, ref)
         if ref.get("t") != "tree":
             return ref  # legacy single-blob summary
         from ..service.summary_trees import materialize_tree

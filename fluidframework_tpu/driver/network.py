@@ -71,11 +71,14 @@ class LogTruncatedError(RuntimeError):
     never fill. (The driver's own class, mirroring the service-side
     exception: drivers never import service modules.)"""
 
-    def __init__(self, base: int):
+    def __init__(self, base: int, snapshot_seq=None):
         super().__init__(
             f"op log truncated below seq {base}: reload from the latest "
             "acked summary")
         self.base = base
+        # the snapshot-backed base the server advertised: an acked
+        # summary at this seq boots past the hole (always ≥ base)
+        self.snapshot_seq = snapshot_seq
 
 
 class _Transport:
@@ -96,6 +99,10 @@ class _Transport:
         # same reader thread, so by the time the reply is matched every
         # block for that rid has landed here
         self._blocks: dict[int, list] = {}
+        # chunk_hash → raw snapcols chunk bytes from FT_COLS_SNAP pushes
+        # (content-addressed, so the hash — not a rid — is the key; same
+        # same-thread ordering guarantee as _blocks)
+        self._snap_chunks: dict[str, bytes] = {}
         self._pending_cv = threading.Condition()
         self._push_handlers: dict[str, Callable[[dict], None]] = {}
         # binary ops batches bypass the dict layer entirely
@@ -185,13 +192,20 @@ class _Transport:
         if reply.get("t") == "error":
             self._blocks.pop(rid, None)
             if reply.get("code") == "log_truncated":
-                raise LogTruncatedError(int(reply.get("base", 0)))
+                raise LogTruncatedError(int(reply.get("base", 0)),
+                                        snapshot_seq=reply.get("snapshotSeq"))
             raise RuntimeError(f"server error: {reply.get('message')}")
         return rid, reply
 
     def take_blocks(self, rid: int) -> list:
         """Claim the decoded backfill messages pushed for ``rid``."""
         return self._blocks.pop(rid, [])
+
+    def take_snap_chunks(self) -> dict:
+        """Claim the snapshot chunks pushed ahead of the last
+        get_snapshot_cols terminal reply."""
+        chunks, self._snap_chunks = self._snap_chunks, {}
+        return chunks
 
     # ------------------------------------------------------------ receiving
 
@@ -244,6 +258,13 @@ class _Transport:
                 if body is None:
                     break
                 if binwire.is_binary(body):
+                    if body[1] == binwire.FT_COLS_SNAP:
+                        # snapshot chunk push: stage raw bytes by content
+                        # hash for the booting requester (decode happens
+                        # on the boot thread, not the reader)
+                        _, h, chunk = binwire.read_snap_chunk(body)
+                        self._snap_chunks[h] = chunk
+                        continue
                     if body[1] == binwire.FT_COLS_DELTAS:
                         # rid-tagged backfill block: decode the column
                         # section client-side and stage it for the
@@ -703,12 +724,15 @@ class NetworkStorage(DocumentStorage):
     summary commits (summaryAck on the live stream)."""
 
     def __init__(self, transport: _Transport, tenant_id: str,
-                 document_id: str, token_provider=None, cache=None):
+                 document_id: str, token_provider=None, cache=None,
+                 counters: Optional[Counters] = None):
         self._t = transport
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
         self._cache = cache
+        self.counters = (counters if counters is not None
+                         else tier_counters("driver"))
         self.rpcs = 0  # storage round trips issued (cache hits don't count)
 
     def _req(self, t: str, **kw) -> dict:
@@ -727,30 +751,102 @@ class NetworkStorage(DocumentStorage):
         return self._req("get_versions", count=count)["versions"]
 
     def get_snapshot_tree(self, version: Optional[dict] = None):
-        if self._cache is None:
-            # uncached path: one RPC, head resolved server-side
-            return self._req("get_tree", version=version)["tree"]
-        entry = self._cache.get(self._tenant, self._doc)
-        if entry is not None and (
-                version is None
-                or version.get("id") == entry["version"].get("id")):
-            return entry["tree"]
+        if self._cache is not None:
+            entry = self._cache.get(self._tenant, self._doc)
+            if entry is not None and (
+                    version is None
+                    or version.get("id") == entry["version"].get("id")):
+                return entry["tree"]
         if version is not None:
-            # explicit (possibly historical) version: serve it but never
-            # cache it — it must not demote a newer cached head
+            # explicit (possibly historical) version: serve it through
+            # the tree shim but never cache it — it must not demote a
+            # newer cached head
             return self._req("get_tree", version=version)["tree"]
-        epoch = self._cache.epoch(self._tenant, self._doc)
-        versions = self._req("get_versions", count=1)["versions"]
-        if not versions:
-            return None
-        head = versions[0]
-        tree = self._req("get_tree", version=head)["tree"]
-        if tree is not None:
+        epoch = (self._cache.epoch(self._tenant, self._doc)
+                 if self._cache is not None else None)
+        # snapshot fast path first: columnar chunks, content-addressed
+        # client dedupe, zero server-side re-serialization
+        head = tree = None
+        try:
+            head, tree = self._snapcols_boot()
+        except (RuntimeError, ValueError, KeyError):
+            # torn/missing chunk, decode failure, or a server predating
+            # the RPC: fall back to the legacy whole-tree path, which
+            # materializes from the same durable store
+            self.counters.inc("boot.snapshot.fallback")
+            head = tree = None
+        if head is None and tree is None:
+            versions = self._req("get_versions", count=1)["versions"]
+            if not versions:
+                return None
+            head = versions[0]
+            tree = self._req("get_tree", version=head)["tree"]
+        if tree is not None and self._cache is not None:
             # epoch-guarded: if a summary ack invalidated mid-fetch,
             # this put is dropped rather than resurrecting stale state
             self._cache.put(self._tenant, self._doc, dict(head), tree,
                             epoch=epoch)
         return tree
+
+    def _snapcols_boot(self):
+        """Boot through the columnar door: one get_snapshot_cols RPC
+        (advertising cached chunk hashes), FT_COLS_SNAP pushes for only
+        the missing chunks, client-side np.frombuffer decode. Returns
+        ``(version, tree)`` — ``(None, None)`` when the doc has no
+        summary yet; raises when the head predates snapcols or a chunk
+        arrives torn/missing (callers fall back to the tree shim)."""
+        import hashlib
+
+        from ..protocol import snapcols
+
+        self.rpcs += 1
+        token = (self._token_provider(self._tenant, self._doc)
+                 if self._token_provider else None)
+        have = (self._cache.chunk_hashes()
+                if self._cache is not None else [])
+        _, reply = self._t.request_rid({
+            "t": "get_snapshot_cols", "tenant": self._tenant,
+            "doc": self._doc, "token": token, "have": have})
+        pushed = self._t.take_snap_chunks()
+        if reply.get("version") is None:
+            return None, None
+        if reply.get("legacy"):
+            raise ValueError("head summary predates snapcols")
+        chunks = []
+        fetched = cached = 0
+        for h in reply["chunks"]:
+            data = pushed.get(h)
+            if data is not None:
+                if hashlib.sha256(data).hexdigest() != h:
+                    raise ValueError(f"torn snapshot chunk {h[:12]}")
+                if self._cache is not None:
+                    self._cache.put_chunk(h, data)
+                fetched += 1
+            else:
+                data = (self._cache.get_chunk(h)
+                        if self._cache is not None else None)
+                if data is None:
+                    raise ValueError(f"missing snapshot chunk {h[:12]}")
+                cached += 1
+            chunks.append(data)
+        self.counters.inc("boot.chunks.fetched", fetched)
+        self.counters.inc("boot.chunks.cached", cached)
+        mergetree = snapcols.decode_snapshot_chunks(
+            chunks, reply["min_seq"], reply["tree_seq"])
+        tree = {
+            "protocol": reply["protocol"],
+            "runtime": {"dataStores": {reply["ds"]: {
+                "pkg": reply["pkg"],
+                "snapshot": {"channels": {reply["channel"]: {
+                    "type": "shared-string",
+                    "snapshot": {"mergetree": mergetree,
+                                 "intervals": {}},
+                }}},
+            }}},
+            "sequence_number": reply["seq"],
+        }
+        self.counters.inc("boot.snapshot.used")
+        return {"id": reply["version"]}, tree
 
     def read_blob(self, blob_id: str) -> bytes:
         return bytes.fromhex(self._req("read_blob", id=blob_id)["hex"])
@@ -818,7 +914,7 @@ class NetworkDocumentService(DocumentService):
     def connect_to_storage(self) -> NetworkStorage:
         return NetworkStorage(self._rpc_transport(), self._tenant,
                               self._doc, self._token_provider,
-                              cache=self._cache)
+                              cache=self._cache, counters=self.counters)
 
 
 class NetworkDocumentServiceFactory(DocumentServiceFactory):

@@ -20,6 +20,11 @@ from typing import Any, Optional
 
 DEFAULT_TTL_S = 120.0
 
+#: cap on cached snapshot chunks (content-addressed, so eviction only
+#: costs a refetch; insertion-order eviction approximates LRU well
+#: enough because chunk reuse clusters on the most recent generations)
+MAX_CHUNKS = 4096
+
 
 class SnapshotCache:
     """``ttl_s`` bounds how stale an entry can get when no live
@@ -29,12 +34,20 @@ class SnapshotCache:
     correct as long as the service retains the covering ops
     (config.log_retention_ops margin)."""
 
-    def __init__(self, ttl_s: float = DEFAULT_TTL_S):
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S,
+                 max_chunks: int = MAX_CHUNKS):
         self._entries: dict[tuple, dict] = {}
         self._epochs: dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._ttl = ttl_s
         self.stats = {"hits": 0, "misses": 0, "invalidations": 0}
+        # content-addressed snapcols chunks, shared across docs AND
+        # versions (identical chunk → identical hash): summary
+        # invalidation does NOT clear these — unchanged chunks of the
+        # NEW version are exactly the reuse this cache exists for
+        self._chunks: dict[str, bytes] = {}
+        self._max_chunks = max_chunks
+        self.chunk_stats = {"hits": 0, "misses": 0}
 
     def epoch(self, tenant_id: str, document_id: str) -> int:
         """Read BEFORE fetching what you intend to put: a put whose
@@ -66,6 +79,24 @@ class SnapshotCache:
                 return  # an invalidation raced the fetch: data is stale
             self._entries[key] = {"version": version, "tree": tree,
                                   "at": time.monotonic()}
+
+    def get_chunk(self, chunk_hash: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._chunks.get(chunk_hash)
+            self.chunk_stats["hits" if data is not None else "misses"] += 1
+            return data
+
+    def put_chunk(self, chunk_hash: str, data: bytes) -> None:
+        with self._lock:
+            while len(self._chunks) >= self._max_chunks:
+                del self._chunks[next(iter(self._chunks))]
+            self._chunks[chunk_hash] = data
+
+    def chunk_hashes(self) -> list[str]:
+        """Hashes on hand — the ``have`` list a booting client sends so
+        the server skips pushing chunks it already holds."""
+        with self._lock:
+            return list(self._chunks)
 
     def invalidate(self, tenant_id: str, document_id: str) -> None:
         """A newer summary committed: the cached boot source is stale

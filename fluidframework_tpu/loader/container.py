@@ -46,6 +46,7 @@ class Container:
         self.delta_manager.connection_handler = self._on_connection_change
         self.delta_manager.nack_handler = self._on_nack
         self.delta_manager.signal_handler = self._on_signal
+        self.delta_manager.on_log_truncated = self._reanchor
         self.protocol: Optional[ProtocolOpHandler] = None
         self.runtime: Optional[ContainerRuntime] = None
         self._runtime_factory = runtime_factory or (lambda c: ContainerRuntime(c))
@@ -69,7 +70,14 @@ class Container:
 
     def load(self, connect: bool = True) -> "Container":
         """Boot from the latest summary (if any) and connect live."""
-        snapshot = self.storage.get_snapshot_tree()
+        self._boot_from(self.storage.get_snapshot_tree())
+        if connect:
+            self.connect()
+        return self
+
+    def _boot_from(self, snapshot: Optional[dict]) -> None:
+        """(Re)build protocol + runtime from a summary snapshot — the
+        boot core of :meth:`load`, reused by the log-truncation reanchor."""
         self._base_snapshot = snapshot
         if snapshot is not None:
             self.existing = True
@@ -88,9 +96,24 @@ class Container:
         if snapshot is not None:
             self.runtime.load_snapshot(snapshot["runtime"],
                                        base_seq=snapshot["sequence_number"])
-        if connect:
-            self.connect()
-        return self
+
+    def _reanchor(self, err: Exception) -> bool:
+        """Backfill hit the retention base (too far behind): drop the
+        stale cached snapshot, re-boot from the LATEST summary — whose
+        capture seq the trim is gated on, so it always lands at or past
+        the hole — and let the delta manager retry the now-bounded tail.
+        Returns False (error propagates) when no newer summary exists."""
+        cache = getattr(self.storage, "_cache", None)
+        if cache is not None:
+            cache.invalidate(self.storage._tenant, self.storage._doc)
+        snapshot = self.storage.get_snapshot_tree()
+        if snapshot is None or snapshot["sequence_number"] \
+                <= self.delta_manager.last_processed_seq:
+            return False
+        self._boot_from(snapshot)
+        if self.delta_manager.counters is not None:
+            self.delta_manager.counters.inc("boot.snapshot.reanchor")
+        return True
 
     def connect(self) -> str:
         client_id = self.delta_manager.connect()

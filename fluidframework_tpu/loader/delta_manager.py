@@ -68,6 +68,16 @@ class DeltaManager:
         # delivery to force specific interleavings, then step/resume
         self._paused = False
         self._pause_buffer: list[SequencedDocumentMessage] = []
+        # log-truncation reanchor hook (the container wires this): the
+        # backfill range reached below the server's retention base —
+        # return True after re-booting from the latest summary (which
+        # advances last_processed_seq past the hole) to retry the tail
+        self.on_log_truncated: Optional[Callable[[Exception], bool]] = None
+        # boot-shape telemetry shared with the driver tier when the
+        # service exposes one (boot.backfill.* — was the catch-up bounded
+        # by a snapshot, or a whole-log replay?)
+        self.counters = getattr(service, "counters", None)
+        self._first_catchup = True
 
     @property
     def connected(self) -> bool:
@@ -95,6 +105,18 @@ class DeltaManager:
             conn.on_nack = self._on_nack
             conn.on_signal = self._on_signal
             conn.on_disconnect = lambda reason: self._on_disconnect(reason)
+            # classify the boot shape BEFORE the op handler goes live:
+            # assigning on_op flushes buffered events (our own join can
+            # already be sitting there), and a buffered op with a gap
+            # runs the whole gap repair inline — which advances
+            # last_processed_seq and would mislabel a whole-log replay
+            # as snapshot-bounded
+            if self._first_catchup and self.counters is not None \
+                    and conn.initial_sequence_number > 0:
+                self._first_catchup = False
+                self.counters.inc(
+                    "boot.backfill.bounded" if self.last_processed_seq > 0
+                    else "boot.backfill.full")
             conn.on_op = self._enqueue  # assigning flushes buffered events
             # repair any gap between our head and the pre-subscription
             # history; everything from the handshake on arrives live
@@ -346,10 +368,30 @@ class DeltaManager:
         return self.last_processed_seq
 
     def _fetch_missing(self, upto: int) -> None:
-        """Backfill (last_processed, upto] from delta storage."""
+        """Backfill (last_processed, upto] from delta storage.
+
+        A ``log_truncated`` refusal (our head is below the server's
+        retention base — duck-typed on ``.base`` so both the local and
+        network drivers' exception classes match) runs the reanchor hook
+        once: the container re-boots from the latest summary, advancing
+        ``last_processed_seq`` past the hole, and the (now bounded) tail
+        fetch retries. No hook, or a hook that cannot reanchor, and the
+        error propagates — it is not silently a partial catch-up."""
         if upto <= self.last_processed_seq:
             return
-        for msg in self._delta_storage.get_deltas(self.last_processed_seq, upto + 1):
+        try:
+            msgs = self._delta_storage.get_deltas(
+                self.last_processed_seq, upto + 1)
+        except RuntimeError as e:
+            if getattr(e, "base", None) is None \
+                    or self.on_log_truncated is None \
+                    or not self.on_log_truncated(e):
+                raise
+            if upto <= self.last_processed_seq:
+                return
+            msgs = self._delta_storage.get_deltas(
+                self.last_processed_seq, upto + 1)
+        for msg in msgs:
             self._reorder.setdefault(msg.sequence_number, msg)
         self._drain_reorder()
 

@@ -86,6 +86,7 @@ FT_COLS_FSUBMIT = 6
 FT_COLS_OPS = 7
 FT_COLS_FOPS = 8
 FT_COLS_DELTAS = 9
+FT_COLS_SNAP = 10
 
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
@@ -1021,6 +1022,23 @@ def read_cols_deltas(body: bytes):
     _, msgs = decode_cols_ops(bytes((MAGIC, FT_COLS_OPS)) + body[6:]
                               + b"\x00")
     return rid, msgs
+
+
+def snap_chunk_body(rid: int, chunk_hash: str, chunk: bytes) -> bytes:
+    """Snapcols chunk → one FT_COLS_SNAP push body, tagged with the u32
+    request id (routing, like FT_COLS_DELTAS) and the content hash (the
+    client's dedupe key). The chunk bytes ride verbatim — the serving
+    cache frames each chunk exactly once per (doc, version)."""
+    h = chunk_hash.encode("ascii")
+    return (bytes((MAGIC, FT_COLS_SNAP)) + rid.to_bytes(4, "big")
+            + _U16.pack(len(h)) + h + chunk)
+
+
+def read_snap_chunk(body: bytes):
+    """FT_COLS_SNAP body → (rid, chunk_hash, chunk bytes)."""
+    rid = int.from_bytes(body[2:6], "big")
+    (hl,) = _U16.unpack_from(body, 6)
+    return rid, body[8:8 + hl].decode("ascii"), body[8 + hl:]
 
 
 # --------------------------------------------------- gateway byte rewrites

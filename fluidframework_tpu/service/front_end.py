@@ -151,7 +151,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
 
 #: Bulk backfill frame types deferred behind the interactive ops of
 #: the same ingress wave (see _handle_conn lane priority).
-_BULK_FRAMES = ("get_deltas_cols", "get_deltas")
+_BULK_FRAMES = ("get_deltas_cols", "get_deltas", "get_snapshot_cols")
 
 
 def _frame_buffered(reader: asyncio.StreamReader) -> bool:
@@ -426,6 +426,9 @@ class _ClientSession:
                     self.push("deltas", {
                         "rid": rid, "blocks": len(payloads), "head": head,
                         "msgs": [message_to_dict(m) for m in legacy]})
+            elif t == "get_snapshot_cols":
+                self._check_rpc_auth(frame, write=False)
+                self._handle_snapshot_cols(frame, rid)
             elif t in ("get_versions", "get_tree", "read_blob",
                        "write_blob", "upload_summary"):
                 self._check_rpc_auth(
@@ -435,8 +438,8 @@ class _ClientSession:
                 self._handle_gateway(t, frame, rid)
             elif t in ("admin_status", "admin_docs", "admin_tenants",
                        "admin_counters", "admin_metrics_scrape",
-                       "admin_slo_status", "admin_tenant_add",
-                       "admin_tenant_remove"):
+                       "admin_slo_status", "admin_summarize",
+                       "admin_tenant_add", "admin_tenant_remove"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -454,6 +457,10 @@ class _ClientSession:
                 # catch-up instead of retrying a range that can never fill
                 err["code"] = "log_truncated"
                 err["base"] = e.base
+                if getattr(e, "snapshot_seq", None) is not None:
+                    # the snapshot-backed base: an acked summary at this
+                    # seq boots the client past the hole
+                    err["snapshotSeq"] = e.snapshot_seq
             self.push("error", err)
 
     def handle_binary(self, body: bytes) -> None:
@@ -801,6 +808,71 @@ class _ClientSession:
         tenants.validate(frame.get("token"), frame["tenant"], frame["doc"],
                          required_scope=SCOPE_WRITE if write else SCOPE_READ)
 
+    def _handle_snapshot_cols(self, frame: dict, rid) -> None:
+        """Encode-once snapshot serving: push the latest snapcols
+        version's chunks as FT_COLS_SNAP frames spliced from a
+        per-(doc, version) cache of ALREADY-FRAMED bytes, then one JSON
+        terminal with the version header. 10k joiners of the same doc get
+        byte-identical splices — the cache frames each chunk exactly once
+        per version (``storage.snapshot.encodes``), like the broadcast
+        fan-out cache. Chunks the client proves it holds (``have``:
+        content-addressed hashes from its snapshot cache) are skipped
+        entirely. Chunk frames carry rid 0: the content hash, not the
+        request, identifies the bytes — that rid-independence is what
+        makes the cached frames shareable across joiners."""
+        front = self.front
+        tenant, doc = frame["tenant"], frame["doc"]
+        storage = front.server_for(tenant, doc).storage(tenant, doc)
+        versions = storage.get_versions(1)
+        if not versions:
+            self.push("snapshot", {"rid": rid, "version": None})
+            return
+        version = versions[0]
+        entry = front._snap_cache.get((tenant, doc))
+        if entry is None or entry[0] != version["id"]:
+            root = json.loads(storage.read_blob(version["tree_id"]).decode())
+            if root.get("t") != "snapcols":
+                # pre-columnar summary at head: the client boots through
+                # the legacy tree shim instead
+                self.push("snapshot", {"rid": rid,
+                                       "version": version["id"],
+                                       "legacy": True})
+                return
+            framed = {h: binwire.frame(binwire.snap_chunk_body(
+                0, h, storage.read_blob(h))) for h in root["chunks"]}
+            entry = (version["id"], framed, root)
+            front._snap_cache[(tenant, doc)] = entry
+            front.counters.inc("storage.snapshot.encodes")
+        else:
+            front.counters.inc("storage.snapshot.cache_hits")
+        vid, framed, root = entry
+        have = set(frame.get("have") or ())
+        plane = front.fault_plane
+        sent = 0
+        for h in root["chunks"]:
+            if h in have:
+                continue
+            raw = framed[h]
+            if plane is not None:
+                directive = plane("snapshot.chunk", tenant=tenant,
+                                  doc=doc, chunk=h)
+                if directive == "drop":
+                    continue  # the client sees a hole and falls back
+                if directive == "torn":
+                    # mangled wire bytes under the real hash: the
+                    # client's sha256 verify must refuse them
+                    raw = binwire.frame(binwire.snap_chunk_body(
+                        0, h, b"\x00chaos-torn\x00"))
+            self.push_raw(raw)
+            sent += 1
+        front.counters.inc("storage.snapshot.served")
+        self.push("snapshot", {
+            "rid": rid, "version": vid, "chunks": list(root["chunks"]),
+            "sent": sent, "seq": root["sequence_number"],
+            "tree_seq": root["tree_seq"], "min_seq": root["min_seq"],
+            "protocol": root["protocol"], "pkg": root["pkg"],
+            "ds": root["ds"], "channel": root["channel"]})
+
     def _handle_storage(self, t: str, frame: dict, rid) -> None:
         storage = self.front.server_for(
             frame["tenant"], frame["doc"]).storage(
@@ -810,6 +882,9 @@ class _ClientSession:
                 "rid": rid,
                 "versions": storage.get_versions(frame.get("count", 1))})
         elif t == "get_tree":
+            # legacy shim: whole-tree JSON materialization per join — the
+            # deprecation counter is the migration's progress gauge
+            self.front.counters.inc("storage.snapshot.legacy_tree")
             self.push("tree", {
                 "rid": rid,
                 "tree": storage.get_snapshot_tree(frame.get("version"))})
@@ -906,6 +981,25 @@ class _ClientSession:
                 "slos": engine.status() if engine is not None else [],
                 "shedding": (front.admission.shedding
                              if front.admission is not None else False)})
+        elif t == "admin_summarize":
+            # force ONE service summary now — the operator/bench door
+            # onto the same machinery as the --summarize-every loop.
+            # Synchronous by design (a loop tick blocks this event loop
+            # identically): the reply returns only once the version is
+            # committed and flushed, so the caller can immediately boot
+            # a joiner through it. Not in the no-secret mutating set:
+            # it only materializes state the op stream already holds.
+            tenant, doc = frame["tenant"], frame["doc"]
+            server = front.server_for(tenant, doc)
+            if server._orderers.get(f"{tenant}/{doc}") is None:
+                # non-creating lookup (like admin_status): a typo'd doc
+                # must not be born as an empty committed summary
+                raise ValueError(f"unknown doc {tenant}/{doc}")
+            version = front._summarizer_for(server).summarize_doc(
+                tenant, doc)
+            if front._log_flush and hasattr(server.log, "flush"):
+                server.log.flush()
+            self.push("admin", {"rid": rid, "version": version})
         elif t == "admin_tenant_add":
             if tenants is None:
                 from .tenants import TenantManager
@@ -1129,6 +1223,13 @@ class NetworkFrontEnd:
     partition's LocalServer and refuses docs this core doesn't own.
     """
 
+    #: chaos seam (fluidframework_tpu/chaos): directives at
+    #: ``snapshot.chunk`` corrupt ("torn") or withhold ("drop") a served
+    #: chunk's WIRE bytes only — the encode-once cache and the durable
+    #: blobs stay intact, so the client's hash check trips and its
+    #: legacy-tree fallback still converges
+    fault_plane = None
+
     def __init__(self, server: Optional[LocalServer] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  max_message_size: Optional[int] = None,
@@ -1154,6 +1255,13 @@ class NetworkFrontEnd:
         # per wire format (see _ClientSession._push_op_batch)
         self._batch_cache: tuple = (None, [None, None])
         self._fops_cache: tuple = (None, b"")
+        # (tenant, doc) → (version_id, {chunk_hash: framed bytes}, root):
+        # the encode-once snapshot serving cache (_handle_snapshot_cols)
+        self._snap_cache: dict = {}
+        # service-summarizer loop (enable_summarizer): per-LocalServer
+        # summarizer instances + the ops-per-summary threshold
+        self.summarize_every: Optional[int] = None
+        self._summarizers: dict = {}
         # socket-tier batching telemetry (net.ingress.*, net.flush.*,
         # net.fanout.*), served read-only by the admin_counters RPC and
         # aggregated under tier="frontend" by the registry scrape
@@ -1378,6 +1486,45 @@ class NetworkFrontEnd:
         elif kind == "applied":
             self.applier_status[(tenant, doc)] = rec["applied_seq"]
 
+    def enable_summarizer(self, every: int) -> "NetworkFrontEnd":
+        """Arm the threshold-driven service-summarizer loop: every doc
+        whose stream advanced ≥ ``every`` sequenced ops since its last
+        summary gets a columnar snapcols summary (host-replica content
+        source — no device applier in this process)."""
+        self.summarize_every = every
+        return self
+
+    def _summarizer_for(self, server):
+        summ = self._summarizers.get(id(server))
+        if summ is None:
+            from .service_summarizer import HostReplicaSource, ServiceSummarizer
+
+            summ = ServiceSummarizer(
+                server, HostReplicaSource(server),
+                ops_per_summary=self.summarize_every)
+            self._summarizers[id(server)] = summ
+        return summ
+
+    async def _summarize_loop(self, interval: float = 0.05) -> None:
+        while True:
+            try:
+                for server in self._all_servers():
+                    by_tenant: dict = {}
+                    for key in list(server._orderers):
+                        tenant, _, doc = key.partition("/")
+                        by_tenant.setdefault(tenant, []).append(doc)
+                    summ = self._summarizer_for(server)
+                    wrote = 0
+                    for tenant, docs in by_tenant.items():
+                        wrote += summ.run_pass(tenant, docs)
+                    if wrote and self._log_flush and \
+                            hasattr(server.log, "flush"):
+                        server.log.flush()
+            except Exception as e:  # noqa: BLE001 — the loop must outlive
+                # one doc's refusal/IO error
+                self.logger.error("summarize_loop_error", message=str(e))
+            await asyncio.sleep(interval)
+
     async def _poll_backchannels(self) -> None:
         while True:
             moved = False
@@ -1413,6 +1560,9 @@ class NetworkFrontEnd:
             # full-composition failure — summary acks never returned)
             self._bg_tasks.append(asyncio.get_running_loop().create_task(
                 self._poll_backchannels()))
+        if self.summarize_every is not None:
+            self._bg_tasks.append(asyncio.get_running_loop().create_task(
+                self._summarize_loop()))
         if self.shard_host is not None:
             loop = asyncio.get_running_loop()
 
@@ -1610,6 +1760,11 @@ def main() -> None:
     parser.add_argument("--no-shed", action="store_true",
                         help="evaluate SLOs but never shed (the "
                              "overload bench's control arm)")
+    parser.add_argument("--summarize-every", type=int, default=None,
+                        metavar="N",
+                        help="run the service summarizer loop: a new "
+                             "columnar snapshot every N sequenced ops "
+                             "per doc (the snapshot fast-boot plane)")
     args = parser.parse_args()
     if args.shard_dir is not None:
         import gc as _gc
@@ -1639,6 +1794,8 @@ def main() -> None:
                                 shard_host=shard_host,
                                 admin_secret=args.admin_secret)
         _apply_overload_flags(front, args, parser)
+        if args.summarize_every is not None:
+            front.enable_summarizer(args.summarize_every)
         front.serve_forever()
         return
     server = None
@@ -1684,6 +1841,8 @@ def main() -> None:
                             max_message_size=args.max_message_size,
                             admin_secret=args.admin_secret)
     _apply_overload_flags(front, args, parser)
+    if args.summarize_every is not None:
+        front.enable_summarizer(args.summarize_every)
     for state_dir in args.consume_backchannel:
         front.attach_backchannel(state_dir)
     front.serve_forever()

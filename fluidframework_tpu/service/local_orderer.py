@@ -192,14 +192,37 @@ class LocalOrderer:
         if self._on_version_persisted is not None:
             self._on_version_persisted(handle, dict(version))
 
+    def acked_boot_seq(self) -> Optional[int]:
+        """Capture seq of the version a joiner would boot from (latest
+        acked by n) — None when no acked summary exists, or when the
+        record predates capture-seq stamping."""
+        from .core import summary_versions_collection
+
+        col = summary_versions_collection(self.tenant_id, self.document_id)
+        acked = [v for v in self._db.collection(col).values()
+                 if v.get("acked")]
+        if not acked:
+            return None
+        return max(acked, key=lambda v: v["n"]).get("seq")
+
     def apply_retention(self, capture_seq: int) -> None:
         """Truncate ops an acked summary covers, minus the in-flight
-        backfill margin (config.log_retention_ops)."""
+        backfill margin (config.log_retention_ops).
+
+        The trim is CLAMPED to the boot version's capture seq: the ack
+        chain orders by parent handle, not by seq, so a later-acked
+        summary can capture an earlier seq than its predecessor — trimming
+        to the raw commit head would then open a log_truncated hole below
+        the only snapshot that heals it. No acked summary ⇒ no trim at
+        all (a joiner would have nothing but full replay)."""
         if self._retention_margin is None:
+            return
+        boot_seq = self.acked_boot_seq()
+        if boot_seq is None:
             return
         self.scriptorium.truncate_below(
             self.tenant_id, self.document_id,
-            capture_seq - self._retention_margin)
+            min(capture_seq, boot_seq) - self._retention_margin)
 
     def commit_external_version(self, handle: str, version: dict) -> None:
         """Apply an external scribe's version commit (stage_runner

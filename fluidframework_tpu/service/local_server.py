@@ -346,9 +346,17 @@ class LocalServer:
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
     ) -> list[SequencedDocumentMessage]:
         """REST backfill (alfred /deltas): ops with from_seq < seq < to_seq."""
+        from .scriptorium import LogTruncatedError
+
         orderer = self._get_orderer(tenant_id, document_id)
-        return orderer.scriptorium.get_deltas(
-            tenant_id, document_id, from_seq, to_seq)
+        try:
+            return orderer.scriptorium.get_deltas(
+                tenant_id, document_id, from_seq, to_seq)
+        except LogTruncatedError as e:
+            # report the snapshot-backed base so the joiner knows a
+            # bootable summary covers the hole
+            e.snapshot_seq = orderer.acked_boot_seq()
+            raise
 
     def get_delta_blocks(
         self, tenant_id: str, document_id: str, from_seq: int, to_seq: int
@@ -371,7 +379,8 @@ class LocalServer:
         orderer = self._get_orderer(tenant_id, document_id)
         base = orderer.scriptorium.retained_base(tenant_id, document_id)
         if from_seq < base:
-            raise LogTruncatedError(base)
+            raise LogTruncatedError(base,
+                                    snapshot_seq=orderer.acked_boot_seq())
         res = blocks(f"deltas/{tenant_id}/{document_id}", from_seq, to_seq)
         if res is None:
             return None

@@ -159,7 +159,10 @@ class ScribeLambda:
             if version is None:
                 raise KeyError(f"unknown summary handle {handle!r}")
         already_acked = bool(version.get("acked"))
-        acked_version = dict(version, acked=True)
+        # the capture seq rides the acked record: retention clamps its
+        # trim to the latest acked version's seq, so a booting client's
+        # backfill base (the snapshot's seq) is always ≥ the retained base
+        acked_version = dict(version, acked=True, seq=head)
         self._db.upsert(self._versions_col, handle, acked_version)
         self.last_summary_head = handle
         if self._persist_version is not None and not already_acked:

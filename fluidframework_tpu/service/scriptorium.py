@@ -17,11 +17,14 @@ class LogTruncatedError(RuntimeError):
     head predates the truncated prefix and it must reload from the
     latest acked summary instead of backfilling op-by-op."""
 
-    def __init__(self, base: int):
+    def __init__(self, base: int, snapshot_seq=None):
         super().__init__(
             f"op log truncated below seq {base}: reload from the latest "
             "acked summary")
         self.base = base
+        # capture seq of the acked summary that heals this hole: retention
+        # clamps its trim to this, so it is always ≥ base when set
+        self.snapshot_seq = snapshot_seq
 
 
 class ScriptoriumLambda:

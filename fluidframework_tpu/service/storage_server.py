@@ -131,6 +131,10 @@ class StorageService:
                 return None
             version = versions[0]
         raw = json.loads(self.read_blob(version["tree_id"]).decode())
+        if raw.get("t") == "snapcols":
+            from .summary_trees import materialize_snapcols
+
+            return materialize_snapcols(self.read_blob, raw)
         if raw.get("t") != "tree":
             return raw  # legacy single-blob summary
         return materialize_tree(self.read_blob,

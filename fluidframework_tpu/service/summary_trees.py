@@ -84,3 +84,39 @@ def materialize_tree(get: Callable[[str], bytes], ref: dict) -> Any:
     node = json.loads(get(ref["id"]).decode())
     return {name: materialize_tree(get, child)
             for name, child in node["e"].items()}
+
+
+def materialize_snapcols(get: Callable[[str], bytes], root: dict) -> dict:
+    """Expand a columnar ``{"t": "snapcols"}`` version root into the
+    classic nested boot dict: pull the content-addressed chunks, decode
+    the columns, and rebuild the single-data-store container shape the
+    loader already understands. This is the LEGACY-COMPAT read path —
+    fast boots splice the framed chunk bytes straight off the wire and
+    never come through here."""
+    from ..protocol import snapcols
+
+    chunks = [get(h) for h in root["chunks"]]
+    mergetree = snapcols.decode_snapshot_chunks(
+        chunks, root["min_seq"], root["tree_seq"])
+    return {
+        "protocol": root["protocol"],
+        "runtime": {
+            "dataStores": {
+                root["ds"]: {
+                    "pkg": root["pkg"],
+                    "snapshot": {
+                        "channels": {
+                            root["channel"]: {
+                                "type": "shared-string",
+                                "snapshot": {
+                                    "mergetree": mergetree,
+                                    "intervals": {},
+                                },
+                            }
+                        }
+                    },
+                }
+            }
+        },
+        "sequence_number": root["sequence_number"],
+    }

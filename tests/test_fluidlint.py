@@ -22,6 +22,7 @@ from tools.fluidlint import (
     hygiene,
     jaxpr_check,
     layers,
+    metrics_check,
     storage_check,
     wire_check,
 )
@@ -250,6 +251,78 @@ def test_storage_undeclared_metric_caught(tmp_path):
 
 def test_storage_real_tree_clean():
     assert storage_check.check_storage(repo_root=REPO) == []
+
+
+def test_snapcols_json_ban_caught(tmp_path):
+    proto = tmp_path / "fluidframework_tpu" / "protocol"
+    proto.mkdir(parents=True)
+    (proto / "snapcols.py").write_text(
+        "import json\n"
+        "def enc(v):\n"
+        "    return json.dumps(v).encode()\n")
+    svc = tmp_path / "fluidframework_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "log_compat.py").write_text("import json\n")
+    vs = storage_check.check_storage(repo_root=str(tmp_path))
+    assert any(v.path.endswith("snapcols.py")
+               and "json import" in v.message for v in vs), \
+        [str(v) for v in vs]
+
+
+def test_snapshot_metric_undeclared_caught(tmp_path):
+    root = _storage_tree(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('storage.snapshot.reencodes')\n")  # not a member
+    vs = storage_check.check_storage(repo_root=root)
+    assert any('undeclared storage metric "storage.snapshot.reencodes"'
+               in v.message for v in vs), [v.message for v in vs]
+
+
+# --------------------------------------------------------------- metrics
+
+def _metrics_file(tmp_path, src):
+    pkg = tmp_path / "fluidframework_tpu"
+    pkg.mkdir()
+    path = pkg / "mod.py"
+    path.write_text(src)
+    return str(path)
+
+
+def test_boot_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('boot.snapshot.fellback')\n")  # not a member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "boot.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "boot.snapshot.fallback" in vs[0].message  # names the members
+
+
+def test_snapshot_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('storage.snapshot.reencoded')\n")
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 \
+        and 'locked "storage.snapshot.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+
+
+def test_boot_family_members_pass(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('boot.snapshot.used')\n"
+        "    c.inc('boot.backfill.bounded')\n"
+        "    c.inc('storage.snapshot.served')\n")
+    assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
+
+
+def test_metrics_real_tree_clean():
+    assert metrics_check.check_metrics(repo_root=REPO) == []
 
 
 # ------------------------------------------------------------------- CLI

@@ -1,0 +1,487 @@
+"""Snapshot fast-boot plane: columnar snapcols summaries, encode-once
+FT_COLS_SNAP serving, O(snapshot+Δ) late-joiner catch-up, and the
+retention/summary coupling that keeps a booting client's backfill base
+retained.
+
+Ref: odsp-driver snapshot-first boot + routerlicious summary serving;
+merge-tree SnapshotV1 (snapshotV1.ts:87) for the chunked snapshot shape.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+
+import pytest
+
+from fluidframework_tpu.chaos import doc_fingerprint
+from fluidframework_tpu.driver import (
+    LocalDocumentServiceFactory,
+    NetworkDocumentServiceFactory,
+)
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.mergetree import MergeTreeClient
+from fluidframework_tpu.protocol import binwire, snapcols
+from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+from fluidframework_tpu.service.service_summarizer import (
+    HostReplicaSource,
+    ServiceSummarizer,
+)
+
+from tests.mergetree_fixtures import FarmClient, FarmServer, random_op
+
+
+def wait_for(pred, timeout=10.0, interval=0.005):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            if pred():
+                return True
+        except (KeyError, IndexError):
+            pass
+        time.sleep(interval)
+    return False
+
+
+def string_fingerprint(s) -> str:
+    text = s.get_text()
+    props = [s.client.get_properties_at(i) or {} for i in range(len(text))]
+    return doc_fingerprint(text, props)
+
+
+def make_doc(loader, tenant, doc, n_ops=40):
+    c = loader.resolve(tenant, doc)
+    s = c.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(n_ops):
+        s.insert_text(0, f"w{i} ")
+    s.annotate_range(0, 3, {"bold": True})
+    return c, s
+
+
+def summarize(server, tenant, doc):
+    svc = ServiceSummarizer(server, HostReplicaSource(server))
+    version = svc.summarize_doc(tenant, doc)
+    assert version is not None
+    return svc, version
+
+
+# =====================================================================
+# snapcols codec: fuzz round-trip vs the JSON twin
+# =====================================================================
+
+def test_snapcols_fuzz_round_trips_vs_json_twin():
+    """Random collaborative histories: the columnar encoding must decode
+    to a merge-tree snapshot byte-identical (as canonical JSON) to the
+    original, across chunk boundaries and partial collab windows."""
+    for seed in range(6):
+        rng = random.Random(seed)
+        clients = [FarmClient(f"c{i}") for i in range(3)]
+        farm = FarmServer(clients, rng)
+        for _ in range(rng.randint(30, 120)):
+            random_op(rng.choice(clients), rng)
+            if rng.random() < 0.4:
+                farm.sequence_one()
+        farm.sequence_all()
+        snap = clients[0].client.snapshot()
+
+        chunks = snapcols.encode_snapshot_chunks(snap, segs_per_chunk=7)
+        decoded = snapcols.decode_snapshot_chunks(
+            chunks, snap["minSeq"], snap["seq"])
+        assert json.dumps(decoded, sort_keys=True) \
+            == json.dumps(snap, sort_keys=True), f"seed {seed}"
+
+        # and a replica LOADED from the decoded form fingerprints equal
+        a = MergeTreeClient.load("a", snap)
+        b = MergeTreeClient.load("b", decoded)
+        assert a.get_text() == b.get_text()
+
+
+def test_snapcols_chunking_is_prefix_stable_under_append():
+    """The canonical snapshot coalesces a quiet doc into ONE growing
+    text run — the text-split chunker must still leave every leading
+    chunk byte-identical after an append, so the content-addressed
+    store dedupes across summary generations."""
+    from fluidframework_tpu.mergetree import op_to_wire
+    from fluidframework_tpu.protocol import (
+        MessageType,
+        SequencedDocumentMessage,
+    )
+
+    c = MergeTreeClient("w")
+    snap1 = None
+    for i in range(160):
+        op = c.insert_text_local(c.get_length(), f"s{i} ")
+        m = SequencedDocumentMessage(
+            client_id="w", sequence_number=i + 1,
+            minimum_sequence_number=i + 1, client_sequence_number=i + 1,
+            reference_sequence_number=i, type=MessageType.OPERATION,
+            contents=op_to_wire(op))
+        c.apply_msg(m, local=True)
+        if i == 150:
+            snap1 = c.snapshot()
+    snap2 = c.snapshot()
+    # the whole doc coalesced into one canonical run in BOTH generations
+    assert len(snap1["segments"]) == 1 and len(snap2["segments"]) == 1
+
+    enc = lambda s: snapcols.encode_snapshot_chunks(  # noqa: E731
+        s, segs_per_chunk=4, text_split=64)
+    chunks1, chunks2 = enc(snap1), enc(snap2)
+    h = lambda b: hashlib.sha256(b).hexdigest()  # noqa: E731
+    assert len(chunks1) >= 3
+    # every chunk but the trailing one survives the append byte-identical
+    assert [h(b) for b in chunks1[:-1]] == [h(b) for b in chunks2[:len(chunks1) - 1]]
+    assert h(chunks1[-1]) != h(chunks2[len(chunks1) - 1])
+    # and both generations still decode to their exact snapshots
+    for snap, chunks in ((snap1, chunks1), (snap2, chunks2)):
+        decoded = snapcols.decode_snapshot_chunks(
+            chunks, snap["minSeq"], snap["seq"])
+        assert json.dumps(decoded, sort_keys=True) \
+            == json.dumps(snap, sort_keys=True)
+
+
+# =====================================================================
+# boot equivalence: snapshot+Δ vs replay-from-0 (local + network lanes)
+# =====================================================================
+
+def test_local_boot_equivalence_snapshot_vs_replay():
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    # replay twin boots BEFORE any summary exists: pure from-0 replay
+    replay = loader.resolve("t", "doc")
+    c1, s1 = make_doc(loader, "t", "doc")
+    svc, _ = summarize(server, "t", "doc")
+    assert svc.summaries_written == 1
+    # ops AFTER the summary: the snapshot boot must splice the Δ tail
+    s1.insert_text(0, "post-summary ")
+
+    booted = loader.resolve("t", "doc")
+    assert booted._base_snapshot is not None  # snapshot+Δ path
+    assert replay._base_snapshot is None      # replay-from-0 path
+    sb = booted.runtime.get_data_store("default").get_channel("text")
+    sr = replay.runtime.get_data_store("default").get_channel("text")
+    assert string_fingerprint(sb) == string_fingerprint(sr) \
+        == string_fingerprint(s1)
+    # the snapshot-booted replica stays live
+    sb.insert_text(0, "live ")
+    assert s1.get_text() == sb.get_text()
+
+
+def test_incremental_summarizer_dedupes_unchanged_chunks():
+    """Generation 2 of a mostly-unchanged doc re-uploads only the tail
+    chunk — storage.snapshot.chunks_reused counts the dedupe."""
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.resolve("t", "doc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(120):
+        s1.insert_text(len(s1.get_text()), f"w{i} ")
+
+    svc = ServiceSummarizer(server, HostReplicaSource(server),
+                            segs_per_chunk=4, text_split=64)
+    assert svc.summarize_doc("t", "doc") is not None
+    written1 = svc.counters.snapshot().get("storage.snapshot.chunks_written")
+    assert written1 >= 2  # several text pieces → multiple chunks
+
+    # append-only delta: the leading chunk is byte-identical in gen 2
+    s1.insert_text(len(s1.get_text()), "tail ")
+    assert svc.summarize_doc("t", "doc") is not None
+    assert svc.counters.snapshot() \
+        .get("storage.snapshot.chunks_reused", 0) >= 1
+    # and the doc still boots correctly from gen 2
+    c2 = loader.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert string_fingerprint(s2) == string_fingerprint(s1)
+
+
+@pytest.fixture
+def front_end():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    yield fe
+    fe.stop()
+
+
+def test_network_snapshot_boot_counters_and_equivalence(front_end):
+    server = front_end.server
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1, s1 = make_doc(loader, "t", "doc", n_ops=60)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    summarize(server, "t", "doc")
+    s1.insert_text(0, "tail ")
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+
+    # fresh factory = cold client cache: the boot must ride FT_COLS_SNAP
+    f2 = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    c2 = Loader(f2).resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert wait_for(lambda: s2.get_text() == s1.get_text())
+    assert string_fingerprint(s2) == string_fingerprint(s1)
+    got = f2.counters.snapshot()
+    assert got.get("boot.snapshot.used") == 1
+    assert got.get("boot.chunks.fetched", 0) >= 1
+    # booted at the summary seq → the delta catch-up was the BOUNDED tail
+    assert got.get("boot.backfill.bounded") == 1
+    assert not got.get("boot.snapshot.fallback")
+
+    srv = front_end.counters.snapshot()
+    assert srv.get("storage.snapshot.encodes") == 1
+    assert srv.get("storage.snapshot.served", 0) >= 1
+
+
+def admin_rpc(port, frame, timeout=30.0):
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        body = json.dumps(dict(frame, rid=1)).encode()
+        s.sendall(len(body).to_bytes(4, "big") + body)
+        buf = b""
+        while True:
+            while len(buf) < 4:
+                buf += s.recv(4096)
+            n = int.from_bytes(buf[:4], "big")
+            while len(buf) < 4 + n:
+                buf += s.recv(4096)
+            reply, buf = json.loads(buf[4:4 + n].decode()), buf[4 + n:]
+            if reply.get("rid") == 1:
+                return reply
+
+
+def test_admin_summarize_rpc_commits_a_bootable_summary(front_end):
+    """The operator door onto the summarizer: one RPC commits a snapcols
+    summary a cold joiner then boots through — and an unknown doc is
+    refused, not born as an empty summary."""
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    c1, s1 = make_doc(Loader(factory), "t", "doc", n_ops=40)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+
+    reply = admin_rpc(front_end.port,
+                      {"t": "admin_summarize", "tenant": "t", "doc": "doc"})
+    assert reply.get("version")
+    # the reply only lands after commit: a joiner can boot immediately
+    f2 = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    c2 = Loader(f2).resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert wait_for(lambda: s2.get_text() == s1.get_text())
+    assert f2.counters.snapshot().get("boot.snapshot.used") == 1
+
+    err = admin_rpc(front_end.port,
+                    {"t": "admin_summarize", "tenant": "t", "doc": "nope"})
+    assert err.get("t") == "error" and "unknown doc" in err["message"]
+    # the refusal must not have created the doc server-side
+    assert "t/nope" not in front_end.server._orderers
+
+
+def test_encode_once_across_joiner_burst(front_end):
+    """N joiners from N cold caches: the server frames each chunk exactly
+    once per summary version — byte-identical splices for everyone."""
+    server = front_end.server
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1, s1 = make_doc(loader, "t", "burst", n_ops=80)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    summarize(server, "t", "burst")
+
+    joiners = []
+    for _ in range(4):
+        f = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+        joiners.append((f, Loader(f).resolve("t", "burst")))
+    for f, c in joiners:
+        s = c.runtime.get_data_store("default").get_channel("text")
+        assert wait_for(lambda: s.get_text() == s1.get_text())
+        assert f.counters.snapshot().get("boot.snapshot.used") == 1
+
+    srv = front_end.counters.snapshot()
+    assert srv.get("storage.snapshot.encodes") == 1, \
+        "per-join re-encodes must be zero"
+    assert srv.get("storage.snapshot.served") == 4
+    assert srv.get("storage.snapshot.cache_hits") == 3
+    # nobody fell back to the legacy whole-tree JSON shim
+    assert not srv.get("storage.snapshot.legacy_tree")
+
+
+def test_client_chunk_cache_skips_refetch(front_end):
+    """A factory that already holds the chunks (content-addressed) boots
+    a second container without refetching them — the ``have`` list lets
+    the server skip the push entirely."""
+    server = front_end.server
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1, s1 = make_doc(loader, "t", "doc", n_ops=50)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    summarize(server, "t", "doc")
+
+    c2 = loader.resolve("t", "doc")
+    got = factory.counters.snapshot()
+    fetched_once = got.get("boot.chunks.fetched", 0)
+    assert got.get("boot.snapshot.used") == 1 and fetched_once >= 1
+
+    # drop the version ENTRY but keep the chunks (invalidate's contract)
+    factory.snapshot_cache.invalidate("t", "doc")
+    c3 = loader.resolve("t", "doc")
+    got = factory.counters.snapshot()
+    assert got.get("boot.snapshot.used") == 2
+    assert got.get("boot.chunks.cached", 0) >= 1
+    assert got.get("boot.chunks.fetched") == fetched_once  # no refetch
+    assert factory.snapshot_cache.chunk_stats["hits"] >= 1
+    s3 = c3.runtime.get_data_store("default").get_channel("text")
+    assert wait_for(lambda: s3.get_text() == s1.get_text())
+
+
+def test_legacy_summary_at_head_uses_tree_shim(front_end):
+    """A doc whose head summary predates snapcols boots through the
+    legacy JSON tree RPC — counted on the deprecation counter, with the
+    columnar attempt recorded as a fallback."""
+    from fluidframework_tpu.runtime.summarizer import SummaryManager
+
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1, s1 = make_doc(loader, "t", "old", n_ops=20)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    sm = SummaryManager(c1, max_ops=10**9)
+    sm.summarize_now()
+    assert wait_for(lambda: sm.summaries_acked == 1)
+
+    f2 = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    c2 = Loader(f2).resolve("t", "old")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert wait_for(lambda: s2.get_text() == s1.get_text())
+    got = f2.counters.snapshot()
+    assert got.get("boot.snapshot.fallback") == 1
+    assert not got.get("boot.snapshot.used")
+    assert front_end.counters.snapshot().get(
+        "storage.snapshot.legacy_tree", 0) >= 1
+
+
+# =====================================================================
+# torn / missing chunk → verified fallback (the chaos seam's unit twin)
+# =====================================================================
+
+def corrupt_cached_frame(front, tenant, doc, body_fn):
+    vid, framed, root = front._snap_cache[(tenant, doc)]
+    h0 = root["chunks"][0]
+    framed = dict(framed)
+    framed[h0] = binwire.frame(body_fn(h0))
+    front._snap_cache[(tenant, doc)] = (vid, framed, root)
+
+
+@pytest.mark.parametrize("mode", ["torn", "missing"])
+def test_corrupt_chunk_falls_back_and_converges(front_end, mode):
+    """A torn frame (bytes ≠ hash) or a frame for the wrong hash must be
+    DETECTED client-side (sha256 verify) and heal through the legacy
+    path — counted, never silently booted from garbage."""
+    server = front_end.server
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    loader = Loader(factory)
+    c1, s1 = make_doc(loader, "t", "doc", n_ops=50)
+    assert wait_for(lambda: c1.runtime.pending.count == 0)
+    summarize(server, "t", "doc")
+
+    loader.resolve("t", "doc")  # primes the serving cache
+    if mode == "torn":
+        corrupt_cached_frame(
+            front_end, "t", "doc",
+            lambda h: binwire.snap_chunk_body(0, h, b"torn bytes"))
+    else:
+        corrupt_cached_frame(
+            front_end, "t", "doc",
+            lambda h: binwire.snap_chunk_body(0, "0" * 64, b"x"))
+
+    f2 = NetworkDocumentServiceFactory("127.0.0.1", front_end.port)
+    c2 = Loader(f2).resolve("t", "doc")
+    got = f2.counters.snapshot()
+    assert got.get("boot.snapshot.fallback") == 1
+    assert not got.get("boot.snapshot.used")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert wait_for(lambda: s2.get_text() == s1.get_text())
+    assert string_fingerprint(s2) == string_fingerprint(s1)
+
+
+# =====================================================================
+# retention/summary coupling: the mid-trim joiner race, both sides
+# =====================================================================
+
+def test_retention_clamped_to_acked_boot_seq_local():
+    """Retention must never trim past the seq a joiner's boot version
+    covers, and a truncation error must carry the snapshot-backed base."""
+    from fluidframework_tpu.config import Config
+    from fluidframework_tpu.service.scriptorium import LogTruncatedError
+
+    server = LocalServer(config=Config().with_overrides(log_retention_ops=0))
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1, s1 = make_doc(loader, "t", "doc", n_ops=30)
+
+    # no acked summary yet → nothing may be trimmed, any joiner replays
+    orderer = server._get_orderer("t", "doc")
+    orderer.apply_retention(orderer.deli.sequence_number)
+    assert orderer.scriptorium.retained_base("t", "doc") == 0
+    assert orderer.acked_boot_seq() is None
+
+    svc, _ = summarize(server, "t", "doc")
+    boot_seq = orderer.acked_boot_seq()
+    assert boot_seq is not None and boot_seq > 0
+    base = orderer.scriptorium.retained_base("t", "doc")
+    assert 0 < base <= boot_seq  # trimmed, but never past the boot seq
+    s1.insert_text(0, "after ")
+
+    # a from-0 backfill is now unservable — but the error names the
+    # snapshot seq that heals it
+    with pytest.raises(LogTruncatedError) as ei:
+        server.get_deltas("t", "doc", 0, orderer.deli.sequence_number + 1)
+    assert ei.value.snapshot_seq == boot_seq
+    # …while a joiner (snapshot+Δ boot) is never stranded
+    c2 = loader.resolve("t", "doc")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    assert s2.get_text() == s1.get_text()
+
+    # even a RE-summarize of an older capture seq cannot un-retain:
+    # the clamp takes min(capture, boot)
+    orderer.apply_retention(boot_seq - 5)
+    assert orderer.scriptorium.retained_base("t", "doc") <= boot_seq
+
+
+def test_stale_cache_reanchors_over_sockets():
+    """The mid-trim race over real sockets: a joiner booting from a
+    SUPERSEDED cached snapshot hits log_truncated on its backfill, and
+    must re-anchor onto the newer summary instead of failing."""
+    from fluidframework_tpu.config import Config
+
+    server = LocalServer(config=Config().with_overrides(log_retention_ops=0))
+    fe = NetworkFrontEnd(server).start_background()
+    try:
+        factory = NetworkDocumentServiceFactory("127.0.0.1", fe.port)
+        loader = Loader(factory)
+        c1, s1 = make_doc(loader, "t", "doc", n_ops=30)
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        svc = ServiceSummarizer(server, HostReplicaSource(server))
+        assert svc.summarize_doc("t", "doc") is not None
+
+        # boot once to capture the (soon stale) cache entry
+        loader.resolve("t", "doc")
+        stale = factory.snapshot_cache.get("t", "doc")
+        assert stale is not None
+
+        # a second generation + trim: ops below the new boot seq vanish
+        for i in range(20):
+            s1.insert_text(0, f"gen2-{i} ")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        assert svc.summarize_doc("t", "doc") is not None
+        orderer = server._get_orderer("t", "doc")
+        assert orderer.scriptorium.retained_base("t", "doc") \
+            > stale["tree"]["sequence_number"]
+
+        # resurrect the stale entry (the race: a boot that read the
+        # cache just before the summary ack invalidated it)
+        factory.snapshot_cache.put(
+            "t", "doc", stale["version"], stale["tree"])
+        c3 = loader.resolve("t", "doc")
+        s3 = c3.runtime.get_data_store("default").get_channel("text")
+        assert wait_for(lambda: s3.get_text() == s1.get_text())
+        assert factory.counters.snapshot() \
+            .get("boot.snapshot.reanchor") == 1
+    finally:
+        fe.stop()

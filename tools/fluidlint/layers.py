@@ -71,9 +71,11 @@ ALLOWED = {
     # seams it arms (service/driver) and the layers they expose, but NO
     # production layer may import chaos back — the seams stay duck-typed
     # (`fault_plane = None` class attrs / module hooks), so disarmed code
-    # has no chaos dependency at all; only tests and the soak import it
+    # has no chaos dependency at all; only tests and the soak import it.
+    # loader/runtime: the soak's snapshot campaign boots full containers
+    # through the columnar fast-boot plane as its late joiners
     "chaos": {"service", "driver", "mergetree", "protocol", "utils",
-              "obs"},
+              "obs", "loader", "runtime"},
 }
 
 #: One-line role per layer, used by the PACKAGES.md generator.

@@ -57,6 +57,18 @@ LOCKED_FAMILIES = {
     "obs.slo.": frozenset({"obs.slo.state", "obs.slo.violations"}),
     "net.admission.": frozenset({"net.admission.shed",
                                  "net.admission.delayed"}),
+    # the snapshot fast-boot plane: the net-smoke catch-up gate, the
+    # join-storm bench, and the chaos soak all key on these exact names
+    "boot.": frozenset({"boot.snapshot.used", "boot.snapshot.fallback",
+                        "boot.snapshot.reanchor", "boot.backfill.bounded",
+                        "boot.backfill.full", "boot.chunks.fetched",
+                        "boot.chunks.cached"}),
+    "storage.snapshot.": frozenset({"storage.snapshot.encodes",
+                                    "storage.snapshot.cache_hits",
+                                    "storage.snapshot.served",
+                                    "storage.snapshot.legacy_tree",
+                                    "storage.snapshot.chunks_written",
+                                    "storage.snapshot.chunks_reused"}),
 }
 
 

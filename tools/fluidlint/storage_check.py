@@ -26,11 +26,14 @@ from typing import Optional
 
 from .report import Violation
 
-#: Modules banned from json (repo-relative). log_compat.py is the shim.
+#: Modules banned from json (repo-relative). log_compat.py is the shim
+#: for the log lane; summary_trees.py is the snapshot lane's exempted
+#: legacy-tree twin (its callers count under storage.snapshot.legacy_tree).
 JSON_BANNED = (
     os.path.join("fluidframework_tpu", "service", "durable_log.py"),
     os.path.join("fluidframework_tpu", "service", "segment_store.py"),
     os.path.join("fluidframework_tpu", "native", "oplog.py"),
+    os.path.join("fluidframework_tpu", "protocol", "snapcols.py"),
 )
 
 COMPAT_SHIM = os.path.join("fluidframework_tpu", "service", "log_compat.py")
@@ -42,6 +45,13 @@ STORAGE_METRICS = frozenset({
     "storage.segment.torn",       # chaos torn-tails left + recovered on a segment stream
     "storage.backfill.byterange", # raw block payloads served by delta_blocks
     "storage.log.legacy_json",    # deltas-lane records still riding the compat shim
+    # snapshot fast-boot plane (the net-smoke catch-up gate keys on these)
+    "storage.snapshot.encodes",        # framed-chunk cache fills (once per version)
+    "storage.snapshot.cache_hits",     # joins served from already-framed bytes
+    "storage.snapshot.served",         # snapshot boots served columnar
+    "storage.snapshot.legacy_tree",    # whole-tree JSON shim trips (deprecation gauge)
+    "storage.snapshot.chunks_written", # chunk blobs uploaded by the summarizer
+    "storage.snapshot.chunks_reused",  # content-addressed dedupe across generations
 })
 
 _METHODS = ("inc", "observe")

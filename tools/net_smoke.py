@@ -21,6 +21,12 @@ amortization points of the socket tier (see ARCHITECTURE.md
   door — the sequenced stream must have ridden the segment lane
   (``storage.segment.appends``) and the server must have served raw
   block byte ranges (``storage.backfill.byterange``);
+- a summarized doc + a burst of three cold joiners booting through the
+  columnar snapshot door — the serving side must frame chunks exactly
+  ONCE for the whole burst (``storage.snapshot.encodes`` == 1), serve
+  every boot from the framed cache (``storage.snapshot.served`` /
+  ``cache_hits``), and every joiner must take the bounded backfill
+  (``boot.backfill.bounded``) with zero legacy-tree fallbacks;
 - a mini-overload burst with the admission gate + a hair-trigger SLO
   armed — ``net.admission.shed`` must rise, ``obs.slo.state`` must
   appear in the scrape, and the driver's transparent shed retries must
@@ -201,6 +207,71 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    # snapshot catch-up door: a summarized doc, then a burst of cold
+    # joiners booting through the columnar snapshot plane — the server
+    # must frame chunks exactly ONCE (encode-once), every joiner must
+    # take the bounded backfill, and none may fall back to the tree shim
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.service_summarizer import (
+        HostReplicaSource,
+        ServiceSummarizer,
+    )
+
+    writer = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", front.port, counters=factory.counters)).resolve(
+        "smoke", "snapdoc")
+    sstr = writer.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(60):
+        sstr.insert_text(0, f"w{i} ")
+    if not wait_for(lambda: writer.runtime.pending.count == 0):
+        print("net_smoke: FAIL — snapshot writer never quiesced",
+              file=sys.stderr)
+        return 1
+    ServiceSummarizer(front.server,
+                      HostReplicaSource(front.server)).summarize_doc(
+        "smoke", "snapdoc")
+    pre_srv = front.counters.snapshot()
+    pre_drv = factory.counters.snapshot()
+    joiners = []
+    for _ in range(3):
+        # cold factory per joiner (fresh snapshot/chunk cache), shared
+        # driver counters so the deltas below cover the whole burst
+        jf = NetworkDocumentServiceFactory("127.0.0.1", front.port,
+                                           counters=factory.counters)
+        joiners.append(Loader(jf).resolve("smoke", "snapdoc"))
+    post_srv = front.counters.snapshot()
+    post_drv = factory.counters.snapshot()
+
+    def _delta(post, pre, name):
+        return post.get(name, 0) - pre.get(name, 0)
+
+    snap_encodes = _delta(post_srv, pre_srv, "storage.snapshot.encodes")
+    if snap_encodes != 1:
+        print(f"net_smoke: FAIL — snapshot serving framed chunks "
+              f"{snap_encodes} times for a 3-joiner burst (encode-once "
+              "requires exactly 1)", file=sys.stderr)
+        return 1
+    if _delta(post_drv, pre_drv, "boot.snapshot.fallback"):
+        print("net_smoke: FAIL — a joiner fell back to the legacy tree "
+              "shim during the snapshot catch-up burst", file=sys.stderr)
+        return 1
+    snap_checks = {
+        "storage.snapshot.served": _delta(
+            post_srv, pre_srv, "storage.snapshot.served"),
+        "storage.snapshot.cache_hits": _delta(
+            post_srv, pre_srv, "storage.snapshot.cache_hits"),
+        "boot.snapshot.used": _delta(
+            post_drv, pre_drv, "boot.snapshot.used"),
+        "boot.backfill.bounded": _delta(
+            post_drv, pre_drv, "boot.backfill.bounded"),
+        "boot.chunks.fetched": _delta(
+            post_drv, pre_drv, "boot.chunks.fetched"),
+    }
+    for j in joiners:
+        j.close()
+    writer.close()
+
     # mini-overload burst: arm the admission gate + a hair-trigger SLO
     # (p99 budget 0 on submit_to_admit, manual tick — no ticker race),
     # deplete the smoke tenant's bucket, and prove the loop closes:
@@ -285,6 +356,7 @@ def main() -> int:
             overload_series.get("fluid_net_admission_shed", {}).values())),
         "driver.submit.shed_retries": drv.get(
             "driver.submit.shed_retries", 0),
+        **snap_checks,
     }
     frames = drv.get("driver.submit.frames", 0)
     ops = drv.get("driver.submit.ops", 0)
