@@ -1061,6 +1061,37 @@ def bench_sharded(knee_rate: float, run_workers) -> dict:
             c.wait(timeout=10)
 
 
+def bench_multichip() -> dict:
+    """Per-device scaling of the doc-mesh lane (tools/bench_multichip):
+    docs axis 1→2→4→8 on forced host devices, in a FRESH process — XLA
+    parses the virtual-device flag once, at first backend init, so this
+    process's already-initialized backend can't host the sweep. Writes
+    the MULTICHIP_r06 artifact as a side effect."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.bench_multichip",
+         "--out", os.path.join(repo, "MULTICHIP_r06.json")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=repo, timeout=600)
+    if out.returncode:
+        return {"ok": False, "rc": out.returncode}
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    return {
+        "ok": result["ok"],
+        "n_devices": result["n_devices"],
+        "forced_host": result["forced_host"],
+        "mesh_vs_local_1shard": result["mesh_vs_local_1shard"],
+        "rungs": [
+            {k: r[k] for k in ("docs_axis", "ops_per_sec",
+                               "scaling_efficiency", "staging_ms_per_wave")}
+            for r in result["rungs"]],
+    }
+
+
 def main() -> None:
     # network first: the latency measurement must not share the process
     # with a TPU tunnel already saturated by the kernel/service benches
@@ -1071,6 +1102,7 @@ def main() -> None:
     scalar_deli = bench_scalar_deli()
     service = bench_service()
     seg_storage = bench_segment_storage()
+    multichip = bench_multichip()
     print(
         json.dumps(
             {
@@ -1162,6 +1194,11 @@ def main() -> None:
                 # vs whole-log replay; encode-once counter-asserted
                 # (per-join snapshot re-encodes == 0)
                 "net_join_storm": join_storm,
+                # per-device scaling of the doc-mesh applier lane (docs
+                # axis 1→2→4→8, forced host devices; full artifact in
+                # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh
+                # tax at one shard — the fast-lane claim needs it ≈ 1
+                "multichip": multichip,
             }
         )
     )

@@ -263,7 +263,7 @@ class DeviceStage:
     it exactly inside either crash window and run the real restore."""
 
     def __init__(self, server, plane: FaultPlane, counters: Counters,
-                 state_dir: str):
+                 state_dir: str, mesh_shards: int = 0):
         from ..service.tpu_applier import TpuDocumentApplier
 
         self.server = server
@@ -271,7 +271,13 @@ class DeviceStage:
         self.counters = counters
         self.ckpt = os.path.join(state_dir, "applier")
         self.topic = f"deltas/{TENANT}/{DOC}"
-        self.applier = TpuDocumentApplier(max_docs=8, max_slots=64)
+        # mesh_shards > 0 runs the stage's applier over a doc-sharded
+        # device mesh (the multi-chip fast lane) — the whole
+        # crash/checkpoint/restore protocol must hold there too
+        self.mesh_shards = mesh_shards
+        self.applier = TpuDocumentApplier(
+            max_docs=8, max_slots=64,
+            **({"mesh": mesh_shards} if mesh_shards else {}))
         self.applier.set_replay_source(self._replay_from_log)
         self._offset = -1   # highest offset consumed
         self._handler = None
@@ -344,10 +350,11 @@ class DeviceStage:
                                            load_applier_checkpoint)
 
         self.server.log.unsubscribe(self.topic, self._handler)
+        kw = {"mesh": self.mesh_shards} if self.mesh_shards else {}
         if os.path.exists(self.ckpt + ".json"):
-            self.applier = load_applier_checkpoint(self.ckpt)
+            self.applier = load_applier_checkpoint(self.ckpt, **kw)
         else:
-            self.applier = TpuDocumentApplier(max_docs=8, max_slots=64)
+            self.applier = TpuDocumentApplier(max_docs=8, max_slots=64, **kw)
         self.applier.set_replay_source(self._replay_from_log)
         start = 0
         if os.path.exists(self.ckpt + ".off"):
@@ -387,8 +394,9 @@ def _schedule_phase_a(plane: FaultPlane) -> None:
 
 def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
                 n_clients: int = 3, recover: bool = True,
-                break_dedupe: bool = False) -> tuple[FaultPlane,
-                                                     InvariantMonitor]:
+                break_dedupe: bool = False,
+                mesh_shards: int = 0) -> tuple[FaultPlane,
+                                               InvariantMonitor]:
     from ..service.local_server import LocalServer
 
     monitor = InvariantMonitor(counters, dedupe=not break_dedupe)
@@ -400,7 +408,8 @@ def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
     uninstall = install(plane, server=server)
     try:
         with tempfile.TemporaryDirectory(prefix="chaos-soak-") as state_dir:
-            device = DeviceStage(server, plane, counters, state_dir)
+            device = DeviceStage(server, plane, counters, state_dir,
+                                 mesh_shards=mesh_shards)
             install(plane, appliers=[device.applier])
             rng = random.Random(seed)
             clients = [SoakClient(server, monitor, counters,
@@ -916,7 +925,8 @@ def _cross_check(counters: Counters) -> None:
 
 
 def run_soak(seed: int, quick: bool = False, break_dedupe: bool = False,
-             no_recover: bool = False, phases: str = "ab") -> dict:
+             no_recover: bool = False, phases: str = "ab",
+             mesh_shards: int = 0) -> dict:
     counters = tier_counters("chaos")
     planes = []
     monitors = []
@@ -924,7 +934,8 @@ def run_soak(seed: int, quick: bool = False, break_dedupe: bool = False,
         plane_a, mon_a = run_phase_a(
             seed, counters,
             rounds=10 if quick else 24,
-            recover=not no_recover, break_dedupe=break_dedupe)
+            recover=not no_recover, break_dedupe=break_dedupe,
+            mesh_shards=mesh_shards)
         planes.append(plane_a)
         monitors.append(mon_a)
     if "b" in phases:
@@ -982,11 +993,31 @@ def main(argv=None) -> int:
     parser.add_argument("--no-recover", action="store_true",
                         help="self-test: clients never resubmit "
                              "(the soak MUST fail)")
+    parser.add_argument("--mesh-shards", type=int, default=0,
+                        help="run phase A's applier stage over a "
+                             "doc-sharded device mesh of this many shards "
+                             "(forces host virtual devices if needed)")
     args = parser.parse_args(argv)
+    if args.mesh_shards > 1:
+        # XLA parses the virtual-device flag once, at first backend init
+        # (same dance as __graft_entry__.dryrun_multichip)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.mesh_shards}").strip()
+        import jax
+
+        if len(jax.devices()) < args.mesh_shards:
+            from jax.extend import backend as _jax_backend
+
+            jax.config.update("jax_platforms", "cpu")
+            _jax_backend.clear_backends()
     try:
         result = run_soak(args.seed, quick=args.quick,
                           break_dedupe=args.break_dedupe,
-                          no_recover=args.no_recover, phases=args.phases)
+                          no_recover=args.no_recover, phases=args.phases,
+                          mesh_shards=args.mesh_shards)
     except InvariantViolation as e:
         # attach the flight-recorder dump (if one fired) so the failure
         # report carries the telemetry that preceded the trigger

@@ -407,6 +407,94 @@ def wave_min_seq(ops) -> jax.Array:
     return jnp.max(ops[..., F_MSN], axis=-1)
 
 
+# ------------------------------------------------------ packed wave format
+#
+# The host↔device link is the op path's bottleneck (measured ~6.5 MB/s
+# over a tunneled device vs 71 ms for the apply itself), so the dense
+# [D, K] wave ships as int16 DELTAS plus int32 per-doc bases and is
+# widened back to the int32 field layout on device. The format lives
+# here so both dense lanes — the single-device step
+# (service/tpu_applier._dense_step_for) and the doc-sharded mesh step
+# (parallel/sharded_apply.make_sharded_packed_step) — encode and decode
+# the exact same wire layout. Deltas keep every field in int16 range:
+# seq/text_start are per-doc monotone (delta from the wave's first row),
+# ref/msn trail seq by at most the collaboration window; the host checks
+# the ranges and falls back to the int32 wave when any field escapes.
+
+#: interned id for server/system-originated stamps (never collides with
+#: the dense per-doc client table, which grows upward from 0)
+SYSTEM_CLIENT = (1 << 30) - 1
+
+#: int16 packed-wave sentinel standing in for SYSTEM_CLIENT on the wire
+PACK_SYSTEM = 32767
+
+
+def unpack_wave16(wave16, bases):
+    """Widen a packed int16 [D, K, F] delta wave plus its int32 [D, 2]
+    (seq_base, text_base) to the kernel's int32 field layout, on device.
+
+    Gather-free by construction: ``bases[:, :1]`` is a pure slice (a
+    None-mixed static index would lower to lax.gather, and the kernel
+    contracts budget gathers to compaction only). NOOP padding must not
+    lift the per-doc zamboni floor (wave_min_seq is a max), so its msn
+    is parked far below any real one."""
+    w = wave16.astype(jnp.int32)
+    typ = w[..., F_TYPE]
+    seq = bases[:, :1] + w[..., F_SEQ]
+    ref = seq - w[..., F_REFSEQ]
+    msn = jnp.where(typ == OP_NOOP, -(1 << 20), seq - w[..., F_MSN])
+    client = w[..., F_CLIENT]
+    client = jnp.where(client == PACK_SYSTEM, SYSTEM_CLIENT, client)
+    tstart = bases[:, 1:] + w[..., F_TSTART]
+    return jnp.stack(
+        [typ, w[..., F_POS], w[..., F_END], seq, ref, client,
+         w[..., F_TLEN], tstart, msn, w[..., F_FLAGS],
+         w[..., F_KEY], w[..., F_VAL]], axis=-1)
+
+
+def pack_wave_rows(flat, starts, lens_a):
+    """Host-side twin of ``unpack_wave16`` over concatenated staged rows.
+
+    ``flat`` is int32 [n, OP_FIELDS] (all docs' rows back to back),
+    ``starts``/``lens_a`` delimit each doc's run. Returns
+    ``(packed int64 [n, F], seq_base [m], text_base [m])``; the caller
+    checks the int16 range and scatters ``packed`` into its wave
+    buffers. Bases: seq of the doc's first row; min text_start over its
+    insert rows (text_start of non-inserts is unused — packed 0)."""
+    seq_base = flat[starts, F_SEQ]
+    is_ins = flat[:, F_TYPE] == OP_INSERT
+    tstart_or_inf = np.where(is_ins, flat[:, F_TSTART], np.int64(2 ** 62))
+    text_base = np.minimum.reduceat(tstart_or_inf, starts)
+    text_base = np.where(text_base == 2 ** 62, 0, text_base).astype(np.int64)
+
+    n = len(flat)
+    seq = flat[:, F_SEQ].astype(np.int64)
+    seq_base_row = np.repeat(seq_base.astype(np.int64), lens_a)
+    text_base_row = np.repeat(text_base, lens_a)
+    packed = np.empty((n, OP_FIELDS), np.int64)
+    packed[:, F_TYPE] = flat[:, F_TYPE]
+    packed[:, F_POS] = flat[:, F_POS]
+    packed[:, F_END] = flat[:, F_END]
+    packed[:, F_SEQ] = seq - seq_base_row
+    packed[:, F_REFSEQ] = seq - flat[:, F_REFSEQ]
+    client = flat[:, F_CLIENT]
+    # a REAL interned id of 32767 would collide with the sentinel and be
+    # silently re-attributed to the system client on unpack: force it
+    # (vanishingly rare: 32768 distinct clients in one doc) onto the
+    # wide path via an out-of-range value
+    packed[:, F_CLIENT] = np.where(
+        client == SYSTEM_CLIENT, PACK_SYSTEM,
+        np.where(client == PACK_SYSTEM, np.int64(1) << 40, client))
+    packed[:, F_TLEN] = flat[:, F_TLEN]
+    packed[:, F_TSTART] = np.where(
+        is_ins, flat[:, F_TSTART] - text_base_row, 0)
+    packed[:, F_MSN] = seq - flat[:, F_MSN]
+    packed[:, F_FLAGS] = flat[:, F_FLAGS]
+    packed[:, F_KEY] = flat[:, F_KEY]
+    packed[:, F_VAL] = flat[:, F_VAL]
+    return packed, seq_base, text_base
+
+
 def compact(state: DocState, min_seq) -> DocState:
     """Zamboni, device-side: drop slots whose remove seq ≤ minSeq (no future
     perspective can see them; ref mergeTree.ts:1455) and re-pack in order."""

@@ -14,12 +14,13 @@ sequenced-op log, and horizontal front-end scale-out. Here those become:
 
 from .mesh import make_mesh
 from .placement import DocPlacement
-from .sharded_apply import make_sharded_step
+from .sharded_apply import make_sharded_packed_step, make_sharded_step
 from .long_doc import sharded_visible_prefix, sharded_resolve_position
 
 __all__ = [
     "make_mesh",
     "DocPlacement",
+    "make_sharded_packed_step",
     "make_sharded_step",
     "sharded_visible_prefix",
     "sharded_resolve_position",

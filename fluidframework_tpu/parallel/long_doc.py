@@ -145,6 +145,16 @@ def rebalance_shards(arrays: dict, counts) -> tuple[dict, "jnp.ndarray"]:
     n_shards = len(counts)
     total = int(np.sum(counts))
     per = -(-total // n_shards)  # ceil: even spread
+    cap = next(iter(arrays.values())).shape[1]
+    if per > cap:
+        # an even spread no longer fits: the doc outgrew the WHOLE seg
+        # mesh, not one hot shard — silent out-of-bounds packing here
+        # would corrupt shard-major order, so refuse loudly (the caller's
+        # move is a bigger mesh or larger per-shard slot arrays)
+        raise ValueError(
+            f"doc has {total} live segments but the seg mesh holds "
+            f"{n_shards} x {cap}; rebalancing cannot fit "
+            f"{per} per shard")
     out = {f: np.zeros_like(a) for f, a in arrays.items()}
     new_counts = np.zeros(n_shards, np.int32)
     # concatenate live rows in logical order once

@@ -47,3 +47,32 @@ def make_mesh(
         raise ValueError(f"{n} devices not divisible by seg_shards={seg_shards}")
     grid = np.asarray(devices).reshape(n // seg_shards, seg_shards)
     return Mesh(grid, ("docs", "seg"))
+
+
+def force_host_devices(n_devices: int) -> None:
+    """Ensure ``len(jax.devices()) >= n_devices`` by forcing host-platform
+    virtual devices (CPU dev boxes, CI, the multichip bench/soak gates).
+
+    XLA parses ``XLA_FLAGS`` exactly once, at the very first backend
+    init, so the flag must land in the environment before anything
+    queries devices; if a backend already initialized with fewer devices
+    (e.g. an accelerator plugin pinned ``jax_platforms``), fall back to
+    the CPU platform and drop the initialized backend set. No-op when
+    enough devices already exist."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    if len(jax.devices()) < n_devices:
+        from jax.extend import backend as _jax_backend
+
+        jax.config.update("jax_platforms", "cpu")
+        _jax_backend.clear_backends()
+        if len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                f"could not force {n_devices} host devices "
+                f"(have {len(jax.devices())}); was a backend already "
+                "initialized with XLA_FLAGS set differently?")

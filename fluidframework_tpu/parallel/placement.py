@@ -55,6 +55,15 @@ class DocPlacement:
     def lookup(self, tenant_id: str, document_id: str) -> tuple[int, int] | None:
         return self._map.get(self.key(tenant_id, document_id))
 
+    def split_rows(self, rows):
+        """Vectorized global state row → (shard, local_row). The state's
+        doc axis is shard-major (row = shard * slots_per_shard + slot,
+        matching NamedSharding's contiguous blocks), so this is THE map
+        from placement rows to mesh devices; works on ints and numpy
+        arrays alike."""
+        shard = rows // self.slots_per_shard
+        return shard, rows - shard * self.slots_per_shard
+
     def evict(self, tenant_id: str, document_id: str) -> None:
         """Release a doc's slot (idle expiry / doc close)."""
         k = self.key(tenant_id, document_id)

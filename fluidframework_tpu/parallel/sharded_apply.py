@@ -25,6 +25,7 @@ from ..ops.apply import (
     OP_NOOP,
     apply_ops_batch,
     compact_batch,
+    unpack_wave16,
     wave_min_seq,
 )
 from ..ops.doc_state import DocState
@@ -74,6 +75,76 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+# jitted packed steps shared across applier instances, keyed on the mesh
+# (hashable) + build options: per-instance closures would each re-trace
+# and re-compile every wave-shape bucket
+_PACKED_STEP_CACHE: dict = {}
+
+
+def make_sharded_packed_step(mesh: Mesh, donate: bool = True,
+                             use_pallas: bool = False,
+                             pallas_interpret: bool = False,
+                             trace_hook=None):
+    """The mesh lane's fast step pair ``(packed_fn, wide_fn)``:
+
+    ``packed_fn(state, wave16, bases) -> (state', stats)`` takes the
+    int16-delta packed wave (ops/apply.unpack_wave16 wire format) with
+    int32 [D, 2] per-doc bases; ``wide_fn(state, wave)`` is the int32
+    escape lane (giant docs / huge windows / chaos force_wide). Both
+    shard every [D, ...] input over 'docs' — each device unpacks and
+    applies ONLY its own rows — donate the state, and psum scalar stats
+    only, so the step scales linearly over ICI/DCN like the plain
+    ``make_sharded_step``.
+
+    ``trace_hook(kernel, shape)`` (optional) runs at TRACE time inside
+    the jitted body — the service layer injects its recompile-telemetry
+    counter through it (parallel must not import obs; layer DAG)."""
+    key = (mesh, donate, use_pallas, pallas_interpret)
+    fn = _PACKED_STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+    if use_pallas:
+        from ..ops.pallas_apply import pallas_apply_ops_batch
+
+        def apply_fn(state, wave):
+            return pallas_apply_ops_batch(
+                state, wave, interpret=pallas_interpret)
+    else:
+        apply_fn = apply_ops_batch
+
+    def _apply_local(state, wave, shape):
+        if trace_hook is not None:
+            trace_hook("sharded_step_packed", shape)
+        state = apply_fn(state, wave)
+        state = compact_batch(state, wave_min_seq(wave))
+        applied = jnp.sum((wave[..., F_TYPE] != OP_NOOP).astype(jnp.int32))
+        overflowed = jnp.sum(state.overflow.astype(jnp.int32))
+        stats = {
+            "applied_ops": jax.lax.psum(applied, "docs"),
+            "overflow_docs": jax.lax.psum(overflowed, "docs"),
+        }
+        return state, stats
+
+    def _local_packed(state: DocState, wave16, bases):
+        shape = "x".join(map(str, wave16.shape[:2]))
+        return _apply_local(state, unpack_wave16(wave16, bases), shape)
+
+    def _local_wide(state: DocState, wave):
+        shape = "x".join(map(str, wave.shape[:2])) + "w"
+        return _apply_local(state, wave, shape)
+
+    dp = P("docs")
+    don = (0,) if donate else ()
+    packed = shard_map(_local_packed, mesh=mesh, in_specs=(dp, dp, dp),
+                       out_specs=(dp, P()), check_vma=False)
+    wide = shard_map(_local_wide, mesh=mesh, in_specs=(dp, dp),
+                     out_specs=(dp, P()), check_vma=False)
+    fn = (jax.jit(packed, donate_argnums=don),
+          jax.jit(wide, donate_argnums=don))
+    _PACKED_STEP_CACHE[key] = fn
+    return fn
+
+
 def _contract_build():
     """Build the sharded step on a 1-device 'docs' mesh — the contract
     is about the traced program, which is shard-count-invariant."""
@@ -102,4 +173,40 @@ register_kernel_contract(
     max_gathers=10,
     single_jit=True,
     notes="doc-sharded apply + fused zamboni over the 'docs' mesh axis",
+)
+
+
+def _packed_contract_build():
+    """The packed mesh step at a small fixed geometry on a 1-device
+    'docs' mesh (the traced program is shard-count-invariant)."""
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("docs",))
+    step, _wide = make_sharded_packed_step(mesh, donate=False)
+
+    def example():
+        D, S, K = 8, 16, 4
+        state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+        state = shard_state(state, mesh)
+        wave16 = jnp.zeros((D, K, OP_FIELDS), jnp.int16)
+        bases = jnp.zeros((D, 2), jnp.int32)
+        return (state, wave16, bases), {}
+
+    return step, example
+
+
+# contract: the wave arrives int16 and must be EXPLICITLY widened before
+# any arithmetic (no_int16_arithmetic catches silent promotion); the
+# unpack+apply is gather-free, the fused zamboni repack owns the only
+# gathers (one per DocState field, once per wave, off the K-amplified
+# path); psum of scalar stats is a collective, not a memory gather; one
+# compile per wave-shape bucket.
+register_kernel_contract(
+    "parallel.sharded_step_packed",
+    build=_packed_contract_build,
+    no_scatter=True,
+    max_gathers=10,
+    no_int16_arithmetic=True,
+    single_jit=True,
+    notes="int16 packed-wave unpack + doc-sharded apply + fused zamboni",
 )

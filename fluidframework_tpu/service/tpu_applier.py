@@ -28,6 +28,7 @@ Semantics guardrails:
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace
 from typing import Optional
 
@@ -39,7 +40,6 @@ from ..mergetree.client import MergeTreeClient
 from ..ops.apply import (
     F_CLIENT,
     F_END,
-    F_FLAGS,
     F_KEY,
     F_MSN,
     F_POS,
@@ -53,10 +53,12 @@ from ..ops.apply import (
     OP_ANNOTATE,
     OP_FIELDS,
     OP_INSERT,
-    OP_NOOP,
     OP_REMOVE,
+    SYSTEM_CLIENT,
     apply_ops_batch,
     compact_batch,
+    pack_wave_rows,
+    unpack_wave16,
     wave_min_seq,
 )
 from ..ops.doc_state import FLAG_MARKER, DocState, PropTable, TextArena, decode_state
@@ -66,17 +68,12 @@ from ..utils.contracts import register_kernel_contract
 
 MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 
-# interned id for server/system-originated stamps (never collides with the
-# dense per-doc table, which grows upward from 0)
-SYSTEM_CLIENT = (1 << 30) - 1
+# SYSTEM_CLIENT / PACK_SYSTEM and the int16-delta wire format now live in
+# ops/apply.py (shared with the mesh lane's packed sharded step)
 
 # jitted dense steps shared across applier instances, keyed (D, K):
 # per-instance closures would each re-trace/re-compile every shape bucket
 _DENSE_STEP_CACHE: dict = {}
-
-# int16 packed-wave sentinel for the system client id (SYSTEM_CLIENT
-# itself is 1<<30-1, far outside int16)
-_PACK_SYSTEM = np.int16(32767)
 
 
 def _count_trace(kernel: str, shape: str) -> None:
@@ -94,18 +91,16 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
                     pallas_interpret: bool = False):
     """The wave arrives PACKED from the host: int16[D, K, F] deltas plus
     int32[D, 2] per-doc bases (seq, text_start), unpacked to the kernel's
-    int32 field layout on device with elementwise math.
+    int32 field layout on device with elementwise math (the shared wire
+    format — rationale and codec in ops/apply.py's packed-wave section).
 
-    Why this shape: the host↔device link is the op path's bottleneck
-    (measured ~6.5 MB/s over the tunneled device, vs 71 ms for the
-    apply itself), so bytes-per-op is the number to minimize. Device-side
-    scatter/row-gather of compact rows would avoid padding but costs
-    ~400-550 ms per 64k rows on TPU; shipping the padded [D, K] wave and
-    halving it to int16 is both simpler and faster. Deltas keep every
-    field in int16 range: seq/text_start are per-doc monotone (delta from
-    the wave's first row), ref/msn trail seq by at most the collaboration
-    window. The host checks the ranges and falls back to the int32 wave
-    when any field escapes (huge docs, giant windows).
+    Why this shape: the host↔device link is the op path's bottleneck, so
+    bytes-per-op is the number to minimize. Device-side scatter/row-gather
+    of compact rows would avoid padding but costs ~400-550 ms per 64k rows
+    on TPU; shipping the padded [D, K] wave and halving it to int16 is
+    both simpler and faster. The host checks the delta ranges and falls
+    back to the int32 wave when any field escapes (huge docs, giant
+    windows).
     """
     fn = _DENSE_STEP_CACHE.get((D, K, use_pallas, pallas_interpret))
     if fn is None:
@@ -118,28 +113,9 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
         else:
             apply_fn = apply_ops_batch
 
-        def unpack(wave16, bases):
-            w = wave16.astype(jnp.int32)
-            typ = w[..., F_TYPE]
-            # bases[:, :1] (a pure slice), NOT bases[:, None, 0]: the
-            # None-mixed static index lowers to lax.gather, and the
-            # kernel contract budgets gathers to compaction only
-            seq = bases[:, :1] + w[..., F_SEQ]
-            ref = seq - w[..., F_REFSEQ]
-            # NOOP padding must not lift the per-doc zamboni floor
-            # (wave_min_seq is a max): park its msn far below any real one
-            msn = jnp.where(typ == OP_NOOP, -(1 << 20), seq - w[..., F_MSN])
-            client = w[..., F_CLIENT]
-            client = jnp.where(client == 32767, SYSTEM_CLIENT, client)
-            tstart = bases[:, 1:] + w[..., F_TSTART]
-            return jnp.stack(
-                [typ, w[..., F_POS], w[..., F_END], seq, ref, client,
-                 w[..., F_TLEN], tstart, msn, w[..., F_FLAGS],
-                 w[..., F_KEY], w[..., F_VAL]], axis=-1)
-
         def dense_step(state, wave16, bases):
             _count_trace("dense_step", f"{D}x{K}")
-            wave = unpack(wave16, bases)
+            wave = unpack_wave16(wave16, bases)
             state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
@@ -260,6 +236,13 @@ class TpuDocumentApplier:
         # check before exposing state.
         self.overflow_check_every = overflow_check_every
         self._dispatches_since_check = 0
+        # an int mesh is shorthand for a docs-only axis of that many
+        # shards — callers above the parallel layer (chaos soak) can ask
+        # for a mesh without importing mesh construction themselves
+        if isinstance(mesh, int):
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh(mesh, seg_shards=1)
         # the doc→shard routing table (partition-router role). In mesh
         # mode each 'docs'-axis device owns a contiguous block of state
         # rows (NamedSharding splits axis 0 in mesh order), so placement
@@ -295,18 +278,53 @@ class TpuDocumentApplier:
         self._host_docs: dict[int, MergeTreeClient] = {}  # escalated docs
         self._doc_keys: dict[int, tuple[str, str]] = {}
         self._mesh = mesh
+        # mesh-lane staging-cost counters (the multichip smoke and
+        # bench_multichip read these: per-wave staged bytes must scale
+        # with ACTIVE shards, never with max_docs)
+        self.mesh_waves = 0
+        self.mesh_active_shards = 0
+        self.mesh_staged_bytes = 0
+        self.mesh_stage_seconds = 0.0
+        use_pallas = (use_pallas if use_pallas is not None
+                      else _CFG.applier_use_pallas)
         if mesh is not None:
-            from ..parallel.sharded_apply import make_sharded_step, shard_state
+            from ..parallel.sharded_apply import (
+                doc_sharding, make_sharded_packed_step, shard_state)
 
             self.state = shard_state(self.state, mesh)
-            self._step = make_sharded_step(mesh)
+            sps = self.placement.slots_per_shard
+            if use_pallas and sps % 8:
+                raise ValueError(
+                    "applier_use_pallas requires slots-per-shard % 8 == 0 "
+                    f"(got {sps})")
+            # the mesh twin of _dense_step_for: same int16 packed wave,
+            # unpacked per shard inside shard_map, state donated, stats
+            # psum'd — the dispatch path below is otherwise identical to
+            # the local dense lane (async worker, min-wave, force_wide)
+            self._sharded_step = make_sharded_packed_step(
+                mesh, use_pallas=use_pallas,
+                pallas_interpret=pallas_interpret,
+                trace_hook=_count_trace)
+            self._mesh_sharding = doc_sharding(mesh)
+            # device → docs-shard map for pre-partitioned wave assembly:
+            # P("docs") splits axis 0 into contiguous blocks in mesh
+            # order, so the device whose block starts at shard*sps IS
+            # that placement shard (with a 'seg' axis, its replicas too)
+            by_shard: dict[int, list] = {}
+            for dev, idx in self._mesh_sharding.devices_indices_map(
+                    (max_docs,)).items():
+                by_shard.setdefault((idx[0].start or 0) // sps,
+                                    []).append(dev)
+            self._shard_devices = [by_shard[s]
+                                   for s in range(self.placement.n_shards)]
+            # per-device resident zero shards, reused every wave for
+            # INACTIVE shards (no host alloc, no transfer)
+            self._zero_shards: dict = {}
         else:
             self._step = jax.jit(self._local_step, donate_argnums=(0,))
             # dense dispatch: ship the padded [D, K, F] wave packed to
             # int16 deltas (see _dense_step_for for the wire format and
             # why device-side scatter lost)
-            use_pallas = (use_pallas if use_pallas is not None
-                          else _CFG.applier_use_pallas)
             if use_pallas and max_docs % 8:
                 raise ValueError(
                     "applier_use_pallas requires max_docs % 8 == 0 "
@@ -636,26 +654,10 @@ class TpuDocumentApplier:
     def _flush_sync(self) -> int:
         total = 0
         while self._staged:
-            parts = self._take_wave_locked()
-            if self._mesh is None:
-                total += self._dispatch_wave(parts)
-            else:
-                batch = np.zeros(
-                    (self.max_docs, self.K, OP_FIELDS), np.int32)
-                for slot, chunks, count in parts:
-                    if count == 0:
-                        continue
-                    rows = (chunks[0] if len(chunks) == 1
-                            else np.concatenate(chunks))
-                    batch[slot, :count] = rows
-                    total += count
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                ops_dev = jax.device_put(
-                    jnp.asarray(batch), NamedSharding(self._mesh, P("docs")))
-                self.state, _ = self._step(self.state, ops_dev)
-                self.dispatches += 1
-                self._dispatches_since_check += 1
+            # one dispatch path for both lanes: _dispatch_wave routes the
+            # packed wave to the local dense step or the mesh's sharded
+            # step (per-shard staging + pre-partitioned transfer)
+            total += self._dispatch_wave(self._take_wave_locked())
         self.ops_applied += total
         if self._dispatches_since_check >= self.overflow_check_every:
             self._check_overflow()
@@ -700,14 +702,17 @@ class TpuDocumentApplier:
         return parts
 
     def _dispatch_wave(self, parts) -> int:
-        """Pack the wave host-side and dispatch it (see _dense_step_for
-        for the wire-format rationale).
+        """Pack the wave host-side and dispatch it (ops/apply.py's
+        packed-wave section documents the int16-delta wire format).
 
         One vectorized fancy-index write places every occupied row; the
         flat rows build as ONE ``np.array`` over the concatenated tuple
         list (per-doc conversions were the dominant host cost at high doc
         counts). ``_take_wave_locked`` caps each doc at K ops, so a wave
-        always fits."""
+        always fits. In mesh mode the scatter targets compact per-shard
+        buffers for ACTIVE shards only (_dispatch_wave_mesh) — never an
+        O(max_docs) dense host array."""
+        t0 = time.perf_counter() if self._mesh is not None else 0.0
         all_chunks: list = []
         slots: list[int] = []
         lens: list[int] = []
@@ -728,46 +733,20 @@ class TpuDocumentApplier:
         slots_a = np.array(slots, np.int64)
         doc_idx = np.repeat(slots_a, lens_a)
         pos_idx = np.arange(n, dtype=np.int64) - np.repeat(starts, lens_a)
-        packed_fn, wide_fn = self._dense_step
 
-        # per-doc bases: seq of the doc's first row; min text_start over
-        # its insert rows (text_start of non-inserts is unused — packed 0)
-        seq_base = flat[starts, F_SEQ]
-        is_ins = flat[:, F_TYPE] == OP_INSERT
-        tstart_or_inf = np.where(is_ins, flat[:, F_TSTART], np.int64(2**62))
-        text_base = np.minimum.reduceat(tstart_or_inf, starts)
-        text_base = np.where(text_base == 2**62, 0, text_base).astype(np.int64)
-
-        seq = flat[:, F_SEQ].astype(np.int64)
-        seq_base_row = np.repeat(seq_base.astype(np.int64), lens_a)
-        text_base_row = np.repeat(text_base, lens_a)
-        packed = np.empty((n, OP_FIELDS), np.int64)
-        packed[:, F_TYPE] = flat[:, F_TYPE]
-        packed[:, F_POS] = flat[:, F_POS]
-        packed[:, F_END] = flat[:, F_END]
-        packed[:, F_SEQ] = seq - seq_base_row
-        packed[:, F_REFSEQ] = seq - flat[:, F_REFSEQ]
-        client = flat[:, F_CLIENT]
-        # a REAL interned id of 32767 would collide with the sentinel and
-        # be silently re-attributed to the system client on unpack: force
-        # it (vanishingly rare: 32768 distinct clients in one doc) onto
-        # the wide path via an out-of-range value
-        packed[:, F_CLIENT] = np.where(
-            client == SYSTEM_CLIENT, _PACK_SYSTEM,
-            np.where(client == int(_PACK_SYSTEM), np.int64(1) << 40, client))
-        packed[:, F_TLEN] = flat[:, F_TLEN]
-        packed[:, F_TSTART] = np.where(
-            is_ins, flat[:, F_TSTART] - text_base_row, 0)
-        packed[:, F_MSN] = seq - flat[:, F_MSN]
-        packed[:, F_FLAGS] = flat[:, F_FLAGS]
-        packed[:, F_KEY] = flat[:, F_KEY]
-        packed[:, F_VAL] = flat[:, F_VAL]
+        packed, seq_base, text_base = pack_wave_rows(flat, starts, lens_a)
 
         force_wide = (
             self.fault_plane is not None
             and self.fault_plane("applier.dispatch", ops=n) == "force_wide")
-        if not force_wide \
-                and (packed.min() >= -32768) and (packed.max() <= 32767):
+        fits16 = (not force_wide
+                  and packed.min() >= -32768 and packed.max() <= 32767)
+        if self._mesh is not None:
+            self._dispatch_wave_mesh(flat, packed if fits16 else None,
+                                     doc_idx, pos_idx, slots_a,
+                                     seq_base, text_base, t0)
+        elif fits16:
+            packed_fn, _ = self._dense_step
             wave16 = np.zeros((self.max_docs, K, OP_FIELDS), np.int16)
             wave16[doc_idx, pos_idx] = packed.astype(np.int16)
             bases = np.zeros((self.max_docs, 2), np.int32)
@@ -779,12 +758,89 @@ class TpuDocumentApplier:
             # a field escaped int16 (giant doc, huge window): ship the
             # wave at full width — rare, pays a 2x transfer + one extra
             # compile the first time it happens
+            _, wide_fn = self._dense_step
             wave = np.zeros((self.max_docs, K, OP_FIELDS), np.int32)
             wave[doc_idx, pos_idx] = flat
             self.state, _ = wide_fn(self.state, jnp.asarray(wave))
         self.dispatches += 1
         self._dispatches_since_check += 1
         return n
+
+    def _dispatch_wave_mesh(self, flat, packed, doc_idx, pos_idx, slots_a,
+                            seq_base, text_base, t0) -> None:
+        """Mesh-lane ship: scatter the wave into per-ACTIVE-shard buffers
+        and hand each mesh device its own addressable shard, so host
+        staging cost and transferred bytes are O(active shards · K),
+        never O(max_docs), and the jitted step sees inputs already in
+        its layout — no host-side global materialization, no XLA
+        resharding. ``packed=None`` ships the int32 wide wave (int16
+        range escape / chaos force_wide)."""
+        sps = self.placement.slots_per_shard
+        K = self.K
+        row_shard, local_doc = self.placement.split_rows(doc_idx)
+        active = [int(s) for s in np.unique(row_shard)]
+        packed_fn, wide_fn = self._sharded_step
+        staged_bytes = 0
+        if packed is not None:
+            p16 = packed.astype(np.int16)
+            doc_shard, local_slot = self.placement.split_rows(slots_a)
+            shard_waves: dict[int, np.ndarray] = {}
+            shard_bases: dict[int, np.ndarray] = {}
+            for s in active:
+                w = np.zeros((sps, K, OP_FIELDS), np.int16)
+                m = row_shard == s
+                w[local_doc[m], pos_idx[m]] = p16[m]
+                b = np.zeros((sps, 2), np.int32)
+                dm = doc_shard == s
+                b[local_slot[dm], 0] = seq_base[dm]
+                b[local_slot[dm], 1] = text_base[dm]
+                shard_waves[s] = w
+                shard_bases[s] = b
+                staged_bytes += w.nbytes + b.nbytes
+            wave_dev = self._mesh_assemble(
+                shard_waves, (K, OP_FIELDS), np.int16)
+            bases_dev = self._mesh_assemble(shard_bases, (2,), np.int32)
+            self.mesh_stage_seconds += time.perf_counter() - t0
+            self.state, _ = packed_fn(self.state, wave_dev, bases_dev)
+        else:
+            shard_waves = {}
+            for s in active:
+                w = np.zeros((sps, K, OP_FIELDS), np.int32)
+                m = row_shard == s
+                w[local_doc[m], pos_idx[m]] = flat[m]
+                shard_waves[s] = w
+                staged_bytes += w.nbytes
+            wave_dev = self._mesh_assemble(
+                shard_waves, (K, OP_FIELDS), np.int32)
+            self.mesh_stage_seconds += time.perf_counter() - t0
+            self.state, _ = wide_fn(self.state, wave_dev)
+        self.mesh_waves += 1
+        self.mesh_active_shards += len(active)
+        self.mesh_staged_bytes += staged_bytes
+
+    def _mesh_assemble(self, shard_bufs: dict, tail: tuple,
+                       dtype) -> jax.Array:
+        """A global [max_docs, *tail] device array assembled from per-
+        shard host buffers via ``jax.make_array_from_single_device_
+        arrays``: every mesh device receives ITS row block directly (one
+        device_put of the compact per-shard buffer; 'seg' replicas share
+        the same buffer), and INACTIVE shards reuse a zero shard already
+        resident on their device — no transfer at all."""
+        key = (np.dtype(dtype).str,) + tail
+        zeros = self._zero_shards.get(key)
+        if zeros is None:
+            z = np.zeros((self.placement.slots_per_shard,) + tail, dtype)
+            zeros = {dev: jax.device_put(z, dev)
+                     for devs in self._shard_devices for dev in devs}
+            self._zero_shards[key] = zeros
+        arrays = []
+        for s, devs in enumerate(self._shard_devices):
+            buf = shard_bufs.get(s)
+            for dev in devs:
+                arrays.append(zeros[dev] if buf is None
+                              else jax.device_put(buf, dev))
+        return jax.make_array_from_single_device_arrays(
+            (self.max_docs,) + tail, self._mesh_sharding, arrays)
 
     def _worker_loop(self) -> None:
         import time as _time
@@ -1103,6 +1159,13 @@ def load_applier_checkpoint(path: str, **applier_kwargs
                 else path + ".npz")
     data = np.load(npz_path)
     applier.state = _DS(**{k: jnp.asarray(data[k]) for k in data.files})
+    if applier._mesh is not None:
+        # a mesh applier's step requires state committed per P("docs");
+        # without this re-shard the first dispatch would silently pay an
+        # XLA relayout of every state array (or fail under shard_map)
+        from ..parallel.sharded_apply import shard_state
+
+        applier.state = shard_state(applier.state, applier._mesh)
     for slot, text in enumerate(meta["arenas"]):
         arena = TextArena()
         if text:
@@ -1113,7 +1176,16 @@ def load_applier_checkpoint(path: str, **applier_kwargs
                            for k, v in meta["client_ids"].items()}
     applier._doc_keys = {int(k): tuple(v)
                          for k, v in meta["doc_keys"].items()}
-    applier.placement = DocPlacement.load(meta["placement"])
+    placement = DocPlacement.load(meta["placement"])
+    if applier._mesh is not None and \
+            placement.n_shards != applier.placement.n_shards:
+        # the row↔device mapping is shard-major: restoring a checkpoint
+        # onto a mesh with a different docs axis would route every doc
+        # to the wrong device's rows
+        raise ValueError(
+            f"checkpoint placement has {placement.n_shards} shards but "
+            f"the mesh's docs axis is {applier.placement.n_shards}")
+    applier.placement = placement
     for k, snap in meta["host_docs"].items():
         tenant_id, document_id = meta["host_doc_names"][k]
         applier._host_docs[int(k)] = MergeTreeClient.load(

@@ -1,0 +1,72 @@
+"""MULTICHIP artifact schema (ISSUE 9 satellite): the v2 reader must
+fold the whole r01..rNN series — new rung-bearing artifacts verbatim,
+old dryrun-era {n_devices, rc, ok, skipped, tail} files normalized — and
+the ci smoke's structural counter-asserts must hold under pytest's
+8-virtual-device config too."""
+
+import json
+import os
+
+import pytest
+
+from tools.bench_multichip import read_multichip, run_smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_reader_normalizes_old_dryrun_schema(tmp_path):
+    old = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+           "tail": "..."}
+    p = tmp_path / "MULTICHIP_r05.json"
+    p.write_text(json.dumps(old))
+    got = read_multichip(str(p))
+    assert got["schema"] == 2
+    assert got["n_devices"] == 8
+    assert got["ok"] is True
+    assert got["rc"] == 0
+    assert got["rungs"] == []
+
+
+def test_reader_treats_old_skipped_as_not_ok(tmp_path):
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({"n_devices": 1, "rc": 0, "ok": True,
+                             "skipped": True}))
+    assert read_multichip(str(p))["ok"] is False
+
+
+def test_reader_passes_v2_through(tmp_path):
+    v2 = {"schema": 2, "platform": "cpu", "n_devices": 8,
+          "forced_host": True,
+          "rungs": [{"docs_axis": 1, "n_docs": 64, "ops_per_sec": 1.0,
+                     "scaling_efficiency": 1.0,
+                     "staging_ms_per_wave": 0.1,
+                     "staged_bytes_per_wave": 100}],
+          "local_dense_ops_per_sec": 1.0, "mesh_vs_local_1shard": 1.0,
+          "ok": True, "rc": 0}
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps(v2))
+    assert read_multichip(str(p)) == v2
+
+
+@pytest.mark.parametrize("rev", ["r01", "r02", "r03", "r04", "r05", "r06"])
+def test_reader_loads_committed_artifact_series(rev):
+    path = os.path.join(REPO, f"MULTICHIP_{rev}.json")
+    if not os.path.exists(path):
+        pytest.skip(f"{rev} artifact not present")
+    got = read_multichip(path)
+    assert got["schema"] == 2
+    assert got["ok"] is True
+    # the r06+ generations must carry real throughput rungs
+    if rev >= "r06":
+        assert len(got["rungs"]) == 4
+        for r in got["rungs"]:
+            assert r["ops_per_sec"] > 0
+            assert 0 < r["scaling_efficiency"] <= 1.25
+        assert got["mesh_vs_local_1shard"] >= 0.9  # acceptance: ≤10% tax
+
+
+def test_smoke_counter_asserts_hold():
+    """The ci.sh gate body, under pytest's forced 8-device config:
+    staged bytes per wave scale with ACTIVE shards and the packed step
+    compiles once per wave shape."""
+    run_smoke()

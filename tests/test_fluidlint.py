@@ -159,6 +159,19 @@ def test_batched_apply_jaxpr_is_gather_free():
     assert not any(p.startswith("scatter") for p in counts), counts
 
 
+def test_packed_sharded_step_contract_holds():
+    """The multi-chip fast lane's contract, run directly: the registered
+    parallel.sharded_step_packed kernel must satisfy all its declared
+    checks (scatter-free, bounded gathers, no silent int16 promotion,
+    single compile) — and must actually be in REQUIRED_KERNELS so a
+    future deregistration can't slip through."""
+    assert "parallel.sharded_step_packed" in jaxpr_check.REQUIRED_KERNELS
+    reg = jaxpr_check.load_registry()
+    vs = [v for v in jaxpr_check.check_kernels(registry=reg, required=())
+          if "sharded_step_packed" in str(v)]
+    assert vs == [], [str(v) for v in vs]
+
+
 # ------------------------------------------------------------------ wire
 
 def test_wire_bad_fixture_caught():

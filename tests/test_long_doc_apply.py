@@ -96,23 +96,18 @@ def test_sharded_apply_matches_single_chip(seed):
     assert out_rows == ref_rows
 
 
-def test_heavy_stream_with_watermark_rebalancing():
-    """Mid-doc inserts pile onto the boundary-owning shard; a long
-    insert-heavy stream therefore needs host rebalancing between waves
-    (the bulk analog of B-tree node splits). Chunked apply with a 75%
-    watermark must track the single-chip kernel exactly."""
+def _run_chunked_with_rebalancing(ops_all, chunk=8):
+    """Chunked sharded apply with watermark rebalancing between waves,
+    against the single-chip reference. Returns (ref_rows, out_rows,
+    counts, rebalances)."""
     from jax.sharding import PartitionSpec as P
 
     from fluidframework_tpu.parallel.long_doc import rebalance_shards
 
-    rng = np.random.default_rng(7)
-    n_ops, chunk = 96, 8
+    n_ops = int(ops_all.shape[0])
     # an op can add up to 3 slots, so the rebalance watermark must leave
     # a full chunk's worst-case growth of headroom
     watermark = S_LOCAL - 3 * chunk
-    ops_all = jnp.asarray(generate_batch_ops(
-        rng, 1, n_ops, remove_fraction=0.15, annotate_fraction=0.05,
-        max_insert=6)[0])
 
     ref = jax.tree.map(jnp.asarray, DocState.empty(S_GLOBAL))
     ref = apply_ops_scan(ref, ops_all)
@@ -161,6 +156,49 @@ def test_heavy_stream_with_watermark_rebalancing():
         np.asarray(ref.count))
     out_rows = _live_rows(
         {f: np.asarray(getattr(state, f)) for f in SLOT_FIELDS}, counts)
+    return ref_rows, out_rows, counts, rebalances
+
+
+def test_heavy_stream_with_watermark_rebalancing():
+    """Mid-doc inserts pile onto the boundary-owning shard; a long
+    insert-heavy stream therefore needs host rebalancing between waves
+    (the bulk analog of B-tree node splits). Chunked apply with a 75%
+    watermark must track the single-chip kernel exactly."""
+    rng = np.random.default_rng(7)
+    ops_all = jnp.asarray(generate_batch_ops(
+        rng, 1, 96, remove_fraction=0.15, annotate_fraction=0.05,
+        max_insert=6)[0])
+    ref_rows, out_rows, counts, rebalances = \
+        _run_chunked_with_rebalancing(ops_all)
     assert out_rows == ref_rows
     assert rebalances >= 1          # the stream really needed it
     assert (counts > 0).sum() > 1   # content spans shards afterwards
+
+
+@pytest.mark.parametrize("seed", [0, 7, 42])
+def test_giant_doc_exceeds_single_shard_budget(seed):
+    """Adversarial giant doc (ISSUE 9): ONE doc whose live segment count
+    exceeds a single shard's S_LOCAL budget — impossible to hold on one
+    seg shard, so the stream only survives via cross-shard rebalancing —
+    must still match the single-chip reference row-for-row."""
+    rng = np.random.default_rng(seed)
+    ops_all = jnp.asarray(generate_batch_ops(
+        rng, 1, 128, remove_fraction=0.08, annotate_fraction=0.05,
+        max_insert=6)[0])
+    ref_rows, out_rows, counts, rebalances = \
+        _run_chunked_with_rebalancing(ops_all)
+    assert len(ref_rows) > S_LOCAL  # the doc genuinely outgrew one shard
+    assert out_rows == ref_rows
+    assert rebalances >= 1
+    assert counts.max() <= S_LOCAL  # no shard holds more than its budget
+
+
+def test_rebalance_refuses_when_doc_outgrows_whole_mesh():
+    """Past total capacity an even spread no longer fits; silent
+    out-of-bounds packing would corrupt shard-major order, so
+    rebalance_shards must refuse loudly."""
+    from fluidframework_tpu.parallel.long_doc import rebalance_shards
+
+    arrays = {"length": np.ones((2, 4), np.int32)}
+    with pytest.raises(ValueError, match="cannot fit"):
+        rebalance_shards(arrays, np.array([5, 5], np.int32))

@@ -1,0 +1,266 @@
+"""Per-device scaling bench for the multi-chip doc mesh (ISSUE 9).
+
+Sweeps the 'docs' mesh axis 1 → 2 → 4 → 8 (forced host devices when the
+real platform has fewer) in WEAK-scaling geometry — 64 docs per shard,
+K=32 ops per doc per wave — and publishes per rung: ops/s, scaling
+efficiency vs the 1-shard rung, host staging cost per wave (the per-shard
+wave-build + pre-partitioned transfer path), and staged bytes per wave.
+The 1-shard rung is also raced against the LOCAL dense lane at the same
+geometry: the mesh lane is only "the fast lane" if the mesh tax at
+n_shards=1 is noise.
+
+On this bench host every "device" is a forced host-platform virtual
+device time-slicing ONE core, so ops/s cannot rise with the axis; the
+artifact carries ``forced_host: true`` and the efficiency column is the
+honest transfer-and-dispatch overhead curve, not an ICI scaling claim.
+
+``--smoke`` (the ci.sh gate) skips the timing sweep and counter-asserts
+the tentpole's structural claims instead:
+  * per-wave staged bytes scale with ACTIVE shards, never with max_docs
+    (the pre-refactor dense wave was O(max_docs) on every wave);
+  * the sharded step compiles exactly once per wave shape.
+
+Artifact schema v2 (MULTICHIP_r06+)::
+
+    {"schema": 2, "platform": ..., "n_devices": 8, "forced_host": true,
+     "rungs": [{"docs_axis": n, "n_docs": D, "ops_per_sec": ...,
+                "scaling_efficiency": ..., "staging_ms_per_wave": ...,
+                "staged_bytes_per_wave": ...}, ...],
+     "local_dense_ops_per_sec": ..., "mesh_vs_local_1shard": ...,
+     "ok": true, "rc": 0}
+
+``read_multichip`` also accepts the pre-r06 dryrun schema
+({n_devices, rc, ok, skipped, tail}) and normalizes it to v2 shape with
+an empty rung list, so dashboards can fold the whole r01..rNN series.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import types
+
+
+def read_multichip(path: str) -> dict:
+    """Load a MULTICHIP artifact of ANY generation as schema v2."""
+    with open(path) as f:
+        raw = json.load(f)
+    if raw.get("schema", 1) >= 2:
+        return raw
+    # r01..r05 dryrun schema: presence/absence of a multi-device compile,
+    # no throughput rungs
+    return {
+        "schema": 2,
+        "platform": None,
+        "n_devices": raw.get("n_devices"),
+        "forced_host": None,
+        "rungs": [],
+        "local_dense_ops_per_sec": None,
+        "mesh_vs_local_1shard": None,
+        "ok": bool(raw.get("ok")) and not raw.get("skipped"),
+        "rc": raw.get("rc"),
+    }
+
+
+def _msg(seq: int) -> types.SimpleNamespace:
+    return types.SimpleNamespace(
+        sequence_number=seq,
+        reference_sequence_number=max(seq - 1, 0),
+        minimum_sequence_number=max(seq - 4, 0),
+        client_id="bench",
+    )
+
+
+_INS = {"type": 0, "pos": 0, "text": "x"}
+_REM = {"type": 1, "start": 0, "end": 1}
+
+
+def _stage_wave(applier, docs, seqs, k: int) -> int:
+    """Stage k ops per doc (insert/remove pairs at the head, so live
+    segments stay flat and zamboni has work every wave). Returns the op
+    count staged."""
+    for d in docs:
+        for _ in range(k // 2):
+            seqs[d] += 1
+            applier.ingest("t", d, _msg(seqs[d]), _INS)
+            seqs[d] += 1
+            applier.ingest("t", d, _msg(seqs[d]), _REM)
+    return len(docs) * (k // 2) * 2
+
+
+def _fence(applier) -> None:
+    import numpy as np
+
+    np.asarray(applier.state.count)
+
+
+def _time_applier(applier, docs, k: int, warmup: int = 2,
+                  timed: int = 8) -> dict:
+    """Ops/s over `timed` full waves (ingest excluded: the bench isolates
+    the wave-build → transfer → dispatch lane, and the host staging slice
+    of it is reported separately from the applier's own counters)."""
+    seqs = {d: 0 for d in docs}
+    for _ in range(warmup):
+        _stage_wave(applier, docs, seqs, k)
+        applier.flush()
+    _fence(applier)
+    stage_s0 = applier.mesh_stage_seconds
+    waves0 = applier.mesh_waves
+    bytes0 = applier.mesh_staged_bytes
+    total_ops = 0
+    elapsed = 0.0
+    for _ in range(timed):
+        total_ops += _stage_wave(applier, docs, seqs, k)
+        t0 = time.perf_counter()
+        applier.flush()
+        _fence(applier)
+        elapsed += time.perf_counter() - t0
+    waves = applier.mesh_waves - waves0
+    return {
+        "ops_per_sec": round(total_ops / elapsed, 1),
+        "staging_ms_per_wave": (
+            round((applier.mesh_stage_seconds - stage_s0) / waves * 1e3, 4)
+            if waves else None),
+        "staged_bytes_per_wave": (
+            (applier.mesh_staged_bytes - bytes0) // waves if waves else None),
+    }
+
+
+DOCS_PER_SHARD = 64
+K = 32
+
+
+def run_sweep(axes=(1, 2, 4, 8)) -> dict:
+    import jax
+
+    from fluidframework_tpu.parallel.mesh import make_mesh
+    from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
+
+    rungs = []
+    for n in axes:
+        D = DOCS_PER_SHARD * n
+        applier = TpuDocumentApplier(
+            max_docs=D, max_slots=64, ops_per_dispatch=K,
+            mesh=make_mesh(n, seg_shards=1))
+        docs = [f"d{i}" for i in range(D)]
+        r = _time_applier(applier, docs, K)
+        rungs.append({"docs_axis": n, "n_docs": D, **r})
+    base = rungs[0]["ops_per_sec"]
+    for r in rungs:
+        r["scaling_efficiency"] = round(
+            r["ops_per_sec"] / (r["docs_axis"] * base), 3)
+
+    # the mesh tax at n_shards=1: same geometry down the local dense lane
+    local = TpuDocumentApplier(max_docs=DOCS_PER_SHARD, max_slots=64,
+                               ops_per_dispatch=K)
+    docs1 = [f"d{i}" for i in range(DOCS_PER_SHARD)]
+    seqs = {d: 0 for d in docs1}
+    for _ in range(2):
+        _stage_wave(local, docs1, seqs, K)
+        local.flush()
+    _fence(local)
+    ops = elapsed = 0
+    for _ in range(8):
+        ops += _stage_wave(local, docs1, seqs, K)
+        t0 = time.perf_counter()
+        local.flush()
+        _fence(local)
+        elapsed += time.perf_counter() - t0
+    local_opsps = round(ops / elapsed, 1)
+    return {
+        "schema": 2,
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "forced_host": jax.devices()[0].platform == "cpu",
+        "rungs": rungs,
+        "local_dense_ops_per_sec": local_opsps,
+        "mesh_vs_local_1shard": round(rungs[0]["ops_per_sec"] / local_opsps,
+                                      3),
+        "ok": True,
+        "rc": 0,
+    }
+
+
+def run_smoke() -> None:
+    """The ci.sh gate: structural counter-asserts, no timing."""
+    from fluidframework_tpu.ops.apply import OP_FIELDS
+    from fluidframework_tpu.parallel.mesh import make_mesh
+    from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
+
+    D, n_shards, k = 64, 8, 8
+    applier = TpuDocumentApplier(max_docs=D, max_slots=32,
+                                 ops_per_dispatch=k,
+                                 mesh=make_mesh(n_shards, seg_shards=1))
+    sps = applier.placement.slots_per_shard
+    per_shard = sps * k * OP_FIELDS * 2 + sps * 2 * 4  # int16 wave + bases
+    dense = D * k * OP_FIELDS * 2 + D * 2 * 4          # the old O(max_docs)
+
+    # one compile per wave shape, measured as growth: the packed step is
+    # cached per mesh across applier instances, so an absolute count
+    # would see shapes compiled by other users of the same mesh
+    packed_fn, wide_fn = applier._sharded_step
+    cache0 = packed_fn._cache_size()
+    wide0 = wide_fn._cache_size()
+
+    # one active doc → exactly one shard's buffers staged per wave
+    seqs = {"d0": 0}
+    for _ in range(10):
+        _stage_wave(applier, ["d0"], seqs, k)
+        applier.flush()
+    assert applier.mesh_waves == 10, applier.mesh_waves
+    assert applier.mesh_active_shards == 10, applier.mesh_active_shards
+    b1 = applier.mesh_staged_bytes // applier.mesh_waves
+    assert b1 == per_shard, (b1, per_shard)
+    assert b1 * n_shards <= dense, (b1, dense)
+
+    # all shards active → bytes scale with ACTIVE shards (8×), still not
+    # with max_docs
+    docs = [f"d{i}" for i in range(D)]
+    seqs = {d: seqs.get(d, 0) for d in docs}
+    w0, by0 = applier.mesh_waves, applier.mesh_staged_bytes
+    for _ in range(10):
+        _stage_wave(applier, docs, seqs, k)
+        applier.flush()
+    waves = applier.mesh_waves - w0
+    b8 = (applier.mesh_staged_bytes - by0) // waves
+    assert b8 == n_shards * per_shard, (b8, n_shards * per_shard)
+
+    # 20 same-shape waves → exactly one new compile on the packed step,
+    # none on the wide lane (it never ran)
+    assert packed_fn._cache_size() - cache0 <= 1, (cache0,
+                                                   packed_fn._cache_size())
+    assert wide_fn._cache_size() == wide0, (wide0, wide_fn._cache_size())
+    import numpy as np
+
+    assert not np.asarray(applier.state.overflow).any()
+    print("bench_multichip --smoke: ok "
+          f"(per-wave bytes {b1} x active shards, dense was {dense})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="structural counter-asserts only (ci.sh gate)")
+    ap.add_argument("--out", default=None,
+                    help="also write the artifact JSON to this path")
+    args = ap.parse_args(argv)
+    from fluidframework_tpu.parallel.mesh import force_host_devices
+
+    force_host_devices(args.devices)
+    if args.smoke:
+        run_smoke()
+        return 0
+    result = run_sweep()
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=1) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,10 +28,21 @@ echo "collection: ok"
 echo "--- socket-tier batching smoke"
 python -m tools.net_smoke
 
+echo "--- multichip mesh smoke (8 forced host devices)"
+# counter-asserts the mesh lane's structural claims: per-wave staged
+# bytes scale with ACTIVE shards (never O(max_docs)), and the sharded
+# step compiles exactly once per wave shape
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m tools.bench_multichip --smoke
+
 if [ "$run_soak" = 1 ]; then
     echo "--- chaos soak (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.soak --seed 0 --quick
     echo "soak: ok"
+    echo "--- chaos soak, 2-shard mesh applier (fixed seed, quick)"
+    python -m fluidframework_tpu.chaos.soak --seed 0 --quick --phases a \
+        --mesh-shards 2
+    echo "mesh soak: ok"
     echo "--- noisy-neighbor overload scenario (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.noisy --seed 0 --quick
     echo "noisy: ok"

@@ -17,7 +17,7 @@ inside a nested program. Checks:
   same-shape inputs and the pjit compilation-cache size must not grow
   on the second call.
 
-The four hot-path kernels named in ``REQUIRED_KERNELS`` must stay
+The five hot-path kernels named in ``REQUIRED_KERNELS`` must stay
 registered — removing a ``@kernel_contract`` registration is itself a
 violation, so coverage cannot silently decay.
 """
@@ -47,6 +47,7 @@ REQUIRED_KERNELS = (
     "ops.apply_ops_batch",
     "ops.pallas_apply_ops_batch",
     "parallel.sharded_step",
+    "parallel.sharded_step_packed",
     "service.dense_step_packed",
 )
 
