@@ -14,6 +14,15 @@ one CLI against the ordering core's admin frames (front_end.py
                                                [--count N]
     python -m fluidframework_tpu.admin metrics --port P
     python -m fluidframework_tpu.admin --port P slo
+    python -m fluidframework_tpu.admin placement --port P
+    python -m fluidframework_tpu.admin migrate TENANT DOC TARGET --port P
+
+``placement`` prints the core's view of the routing plane: the epoch
+table (global epoch + per-partition owner/addr/epoch), the partitions
+this core serves, the lease liveness view, and the ``placement.*``
+counter snapshot. ``migrate`` triggers a live migration of the doc's
+partition to the core at TARGET (a ``host:port`` address as published
+in the epoch table) — point it at the CURRENT owner.
 
 ``slo`` prints one row per armed SLO spec — windowed p99 vs budget,
 state (ok/warn/violated), burn progress — plus whether SLO-burn
@@ -123,6 +132,15 @@ def main(argv=None) -> int:
     sub.add_parser("slo", parents=[common],
                    help="armed SLO specs: windowed p99 vs "
                         "budget, state, burn progress")
+    sub.add_parser("placement", parents=[common],
+                   help="routing plane: epoch table, owned partitions, "
+                        "leases, placement.* counters")
+    s = sub.add_parser("migrate", parents=[common],
+                       help="live-migrate a doc's partition to another "
+                            "core (point --port at the current owner)")
+    s.add_argument("tenant")
+    s.add_argument("doc")
+    s.add_argument("target", help="target core address (host:port)")
     args = p.parse_args(argv)
     if args.port is None:
         p.error("--port is required")
@@ -155,6 +173,31 @@ def main(argv=None) -> int:
         reply = _request(args, {"t": "admin_docs"})
         for d in reply["docs"]:
             print(d)
+    elif args.cmd == "placement":
+        reply = _request(args, {"t": "admin_placement"})
+        pl = reply.get("placement")
+        if pl is None:
+            print("not a sharded core (no placement plane)")
+            return 1
+        print(f"core {pl['owner']} @ {pl['address']}  "
+              f"epoch {pl['epoch']}  owns {pl['owned']}")
+        for k in sorted(pl["parts"], key=int):
+            part = pl["parts"][k]
+            print(f"  part {k}: {part['owner']} @ {part['addr']} "
+                  f"(epoch {part['epoch']})")
+        for k, row in sorted(pl["leases"].items()):
+            print(f"  lease {k}: {row}")
+        for name, v in sorted(pl["counters"].items()):
+            print(f"  {name} {v}")
+    elif args.cmd == "migrate":
+        reply = _request(args, {"t": "admin_migrate_doc",
+                                "tenant": args.tenant, "doc": args.doc,
+                                "target": args.target})
+        fences = reply["fences"]
+        if isinstance(fences, dict):
+            fences = sum(fences.values())
+        print(f"migrated partition {reply['k']} -> {reply['target']} "
+              f"(epoch {reply['epoch']}, {fences} submit(s) fenced)")
     elif args.cmd == "tenants":
         reply = _request(args, {"t": "admin_tenants"})
         for tenant in reply["tenants"]:

@@ -40,6 +40,7 @@ BOUNDARY_CLASSES = {
     "partition": "stage",
     "applier": "device",
     "snapshot": "snapshot",
+    "placement": "placement",
 }
 
 

@@ -17,6 +17,7 @@ from .metrics import (  # noqa: F401
     parse_prometheus,
     reset_registry,
     tier_counters,
+    tier_snapshot,
 )
 from .slo import (  # noqa: F401
     SloEngine,

@@ -368,6 +368,16 @@ def tier_counters(tier: str) -> Counters:
     return c
 
 
+def tier_snapshot(tier: str) -> dict:
+    """Summed counter snapshot across every live Counters instance
+    registered under ``tier`` (``tier_counters`` hands out per-instance
+    objects; this is the process-wide read the admin plane and the
+    chaos verdicts use)."""
+    counts, _ = get_registry()._tier_snapshot()
+    key = (("tier", tier),)
+    return {name: v for (name, k), v in counts.items() if k == key}
+
+
 def parse_prometheus(text: str) -> dict:
     """Parse text exposition → {name: {label-tuple: value}}.
 

@@ -72,6 +72,10 @@ from .scriptorium import LogTruncatedError
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
 DEFAULT_MAX_MESSAGE_SIZE = 16 * 1024  # per-op cap, nacked (ref :96)
+# backoff hint on submits bounced off a sealed (mid-migration)
+# partition: long enough for checkpoint+handoff of a hot partition,
+# short enough to keep the client-visible migration blip small
+MIGRATION_RETRY_S = 0.05
 
 
 def _encode_frame(obj: dict) -> bytes:
@@ -439,7 +443,9 @@ class _ClientSession:
             elif t in ("admin_status", "admin_docs", "admin_tenants",
                        "admin_counters", "admin_metrics_scrape",
                        "admin_slo_status", "admin_summarize",
-                       "admin_tenant_add", "admin_tenant_remove"):
+                       "admin_tenant_add", "admin_tenant_remove",
+                       "admin_placement", "admin_migrate_doc",
+                       "admin_adopt_partition"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -553,6 +559,17 @@ class _ClientSession:
             return ops
         get_registry().inc("net.ingress.ops", len(ops),
                            tenant=conn.tenant_id)
+        if getattr(conn.server, "sealed", False):
+            # partition mid-migration: bounce on the shed-retry lane
+            # (echoed op + retry_after_ms — the driver parks and
+            # resubmits in cseq order against the new owner)
+            from .placement_plane import placement_counters
+
+            placement_counters().inc("placement.submits.redirected", len(ops))
+            self._push_shed_nacks(
+                ops, MIGRATION_RETRY_S, sid,
+                message="partition migrating: resubmit shortly")
+            return []
         adm = self.front.admission
         if adm is None:
             return ops
@@ -563,7 +580,9 @@ class _ClientSession:
         self._push_shed_nacks(ops, retry_s, sid)
         return []
 
-    def _push_shed_nacks(self, ops: list, retry_s: float, sid) -> None:
+    def _push_shed_nacks(self, ops: list, retry_s: float, sid,
+                         message: str = "tenant over admission "
+                                        "budget") -> None:
         """Shed a whole boxcar through the shared nack door: one
         THROTTLING nack per op carrying the op itself plus
         ``retry_after_ms``, pushed over the same wire (or fnack-muxed
@@ -574,7 +593,7 @@ class _ClientSession:
             nack = Nack(
                 operation=op, sequence_number=-1, code=429,
                 type=NackErrorType.THROTTLING,
-                message="tenant over admission budget",
+                message=message,
                 retry_after_ms=ms)
             if sid is None:
                 self.push("nack", {"nack": message_to_dict(nack)})
@@ -609,6 +628,16 @@ class _ClientSession:
         if n:
             get_registry().inc("net.ingress.ops", n,
                                tenant=conn.tenant_id)
+            if getattr(conn.server, "sealed", False):
+                # mid-migration bounce, cold path: materialize the ops
+                # so the shed nacks are byte-identical to the rec door's
+                from .placement_plane import placement_counters
+
+                placement_counters().inc("placement.submits.redirected", n)
+                self._push_shed_nacks(
+                    binwire.cols_to_ops(sc), MIGRATION_RETRY_S, sid,
+                    message="partition migrating: resubmit shortly")
+                return
             adm = front.admission
             if adm is not None:
                 retry_s = adm.check(conn, n, int(sc.cseq[0]))
@@ -1018,6 +1047,54 @@ class _ClientSession:
             if ok and front.shard_host is not None:
                 front.shard_host.save_tenants()
             self.push("admin", {"rid": rid, "ok": ok})
+        elif t == "admin_placement":
+            # read-only: this core's view of the routing plane — the
+            # epoch table, its own claims, the lease liveness view, and
+            # the placement.* counter snapshot (net_smoke's gate source)
+            sh = front.shard_host
+            if sh is None:
+                self.push("admin", {"rid": rid, "placement": None})
+                return
+            rec = sh.table.read()
+            from ..obs import tier_snapshot
+
+            snap = tier_snapshot("placement")
+            self.push("admin", {"rid": rid, "placement": {
+                "owner": sh.owner_id,
+                "address": sh.address,
+                "epoch": rec["epoch"],
+                "parts": rec["parts"],
+                "owned": sorted(sh.servers),
+                "leases": sh.placement.table(),
+                "counters": {name: v for name, v in snap.items()
+                             if name.startswith("placement.")},
+            }})
+        elif t == "admin_migrate_doc":
+            # live migration trigger: move the doc's PARTITION to the
+            # named core. Synchronous ON the event loop by design — the
+            # single-threaded seal→fence→handoff cannot interleave with
+            # a submit frame, which is the no-two-writers proof for the
+            # in-process window (deli's epoch fence covers the rest).
+            # Not in the no-secret mutating set (like admin_summarize):
+            # it moves state the deployment already holds, creates none.
+            sh = front.shard_host
+            if sh is None:
+                raise ValueError("not a sharded core")
+            from .stage_runner import doc_partition
+
+            tenant, doc = frame["tenant"], frame["doc"]
+            k = doc_partition(tenant, doc, sh.n)
+            result = front.migration_engine.migrate(
+                k, frame["target"], on_flip=front._on_migration_flip)
+            self.push("admin", {"rid": rid, **result})
+        elif t == "admin_adopt_partition":
+            # core→core handoff target side (MigrationEngine._rpc_adopt)
+            sh = front.shard_host
+            if sh is None:
+                raise ValueError("not a sharded core")
+            result = front.migration_engine.adopt(
+                int(frame["k"]), frame["from_owner"])
+            self.push("admin", {"rid": rid, **result})
 
     def _unsubscribe_ftopic(self, topic: str) -> None:
         entry = self._ftopics.pop(topic, None)
@@ -1087,6 +1164,8 @@ class ShardHost:
 
         from .placement import DEFAULT_TTL_S, PlacementDir
 
+        from .placement_plane import EpochTable
+
         self.shard_dir = shard_dir
         self.n = n
         self.prefer = set(prefer)
@@ -1096,6 +1175,21 @@ class ShardHost:
         self.placement = PlacementDir(
             os.path.join(shard_dir, "placement"), n,
             ttl_s if ttl_s is not None else DEFAULT_TTL_S)
+        # epoch-numbered routing table (placement_plane): every claim /
+        # release / migration adoption this host performs is recorded
+        # there, so gateways route from one mtime-cached file instead of
+        # per-request lease reads
+        self.table = EpochTable.for_shard_dir(shard_dir)
+        # epoch under which this host claimed each owned partition vs
+        # the latest table epoch seen for it (refreshed once per poll):
+        # table newer than claim ⇒ someone adopted it ⇒ deli's epoch
+        # fence refuses with the current epoch (see _make_server)
+        self.claim_epochs: dict[int, int] = {}
+        self.table_epochs: dict[int, int] = {}
+        # partitions mid-migration: poll must not re-claim them
+        self.migrating: set[int] = set()
+        # shared secret for core→core adoption RPCs (uniform deployment)
+        self.admin_secret: Optional[str] = None
         self.servers: dict[int, LocalServer] = {}
         # ONE TenantManager shared by every partition server of this
         # process (including ones claimed later by takeover), kept in
@@ -1138,6 +1232,13 @@ class ShardHost:
         server.lease_fresh = (
             lambda k=k, margin=margin:
             time.monotonic() - self.hb_times.get(k, 0.0) < margin)
+        # placement epoch fence (deli admission): pure dict compares on
+        # the hot path — the table file is read once per poll
+        server.epoch_fence = (
+            lambda k=k: (self.table_epochs[k]
+                         if (self.table_epochs.get(k, 0)
+                             > self.claim_epochs.get(k, 0))
+                         else None))
         return server
 
     def _reload_tenants(self) -> None:
@@ -1177,9 +1278,14 @@ class ShardHost:
         import time
 
         self._reload_tenants()
+        # refresh the epoch-fence view (one mtime-cached file read);
+        # writes are flock-ordered, so this can only move forward
+        self.table_epochs = self.table.part_epochs()
         if self._start_t is None:
             self._start_t = time.monotonic()
         for k in list(self.servers):
+            if k in self.migrating:
+                continue  # the MigrationEngine owns k's lifecycle now
             if self.placement.heartbeat(k, self.owner_id):
                 self.hb_times[k] = time.monotonic()
             else:
@@ -1190,23 +1296,29 @@ class ShardHost:
                 # the confirmation went stale, so there is no
                 # two-writer window even if this heartbeat ran late.
                 server = self.servers.pop(k)
+                self.claim_epochs.pop(k, None)
                 server.revoke()
                 if self.on_drop is not None:
                     self.on_drop(k, server)
         in_grace = (time.monotonic() - self._start_t
                     < self.placement.ttl_s + 0.5)
         for k in range(self.n):
-            if k in self.servers:
+            if k in self.servers or k in self.migrating:
                 continue
             if k not in self.prefer and in_grace:
                 continue  # let the preferring core take it first
             if self.placement.try_claim(k, self.owner_id, self.address):
+                self.claim_epochs[k] = self.table.record_claim(
+                    k, self.owner_id, self.address or "")
+                self.table_epochs[k] = self.claim_epochs[k]
                 self.hb_times[k] = time.monotonic()
                 self.servers[k] = self._make_server(k)
 
     def release_all(self) -> None:
         for k in list(self.servers):
             self.placement.release(k, self.owner_id)
+            self.table.record_release(k, self.owner_id)
+            self.claim_epochs.pop(k, None)
         self.servers.clear()
 
 
@@ -1237,11 +1349,16 @@ class NetworkFrontEnd:
                  admin_secret: Optional[str] = None):
         self.shard_host = shard_host
         self.admin_secret = admin_secret
+        self.migration_engine = None
         if shard_host is not None:
             # config/tenants shell; never serves. Shares the shard
             # host's deployment-wide tenant registry so the admin
             # surface and enforcement checks see the same state.
             server = LocalServer(tenants=shard_host.tenants)
+            from .placement_plane import MigrationEngine
+
+            shard_host.admin_secret = admin_secret
+            self.migration_engine = MigrationEngine(shard_host)
         self.server = server if server is not None else LocalServer()
         self.logger = self.server.logger.child("front_end")
         self.host = host
@@ -1363,6 +1480,24 @@ class NetworkFrontEnd:
         self.counters.inc("net.flush.performed", flushed)
         if n_all > flushed:
             self.counters.inc("net.flush.elided", n_all - flushed)
+
+    def _on_migration_flip(self, k: int, target_addr: str, epoch: int,
+                           server) -> None:
+        """Post-handoff routing flip (MigrationEngine ``on_flip``, on the
+        loop thread): push the new route to every gateway backbone FIRST
+        — their routing caches patch in-memory, so the reconnects that
+        the session drop below triggers resolve to the target without a
+        table read — then tear down the sealed partition's sessions
+        (direct clients reconnect, gateway sids get ``fdropped``)."""
+        route = {"k": k, "addr": target_addr, "epoch": epoch}
+        for session in list(self._sessions):
+            if session._fsessions or session._ftopics:
+                try:
+                    session.push("fplacement", route)
+                except Exception as e:  # noqa: BLE001
+                    self.logger.error("fplacement_push_error",
+                                      message=str(e))
+        self._drop_server_sessions(server)
 
     def _drop_server_sessions(self, server) -> None:
         """Close every live session bound to a revoked partition server

@@ -112,6 +112,7 @@ class _GatewaySession:
             except BaseException:
                 self._gate_buffer = None
                 self.detach()
+                gw.note_route_failure(frame["tenant"], frame["doc"])
                 raise
             self._gate_buffer, buffered = None, self._gate_buffer
             self.push({"t": "connected", "rid": frame.get("rid"),
@@ -198,13 +199,20 @@ class Gateway:
         self._rid_counter = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self.placement = None
+        self.routing = None
         if shard_dir is not None:
             import os
 
             from .placement import PlacementDir
+            from .placement_plane import EpochTable, RoutingCache
 
             self.placement = PlacementDir(
                 os.path.join(shard_dir, "placement"), shards)
+            # hot-path routing: in-memory dict, epoch-table refresh on
+            # miss, lease read only as the liveness fallback — replaces
+            # the old per-connect owner_of poll (placement_plane)
+            self.routing = RoutingCache(
+                self.placement, EpochTable.for_shard_dir(shard_dir))
         self._upstreams: dict[str, _Upstream] = {}
         self._upstream_dials: dict[str, "asyncio.Future"] = {}
         self._up_default: Optional[_Upstream] = None
@@ -271,16 +279,29 @@ class Gateway:
         k = doc_partition(tenant, doc, self.placement.n)
         deadline = asyncio.get_running_loop().time() + 15.0
         while True:
-            addr = self.placement.owner_of(k)
+            addr = self.routing.resolve(k)
             if addr is not None:
                 try:
                     return await self._open_upstream(addr)
                 except OSError:
-                    pass  # owner died between lease read and connect
+                    # owner died between route and dial: drop the route
+                    # so the retry re-reads table + lease
+                    self.routing.invalidate(k)
             if asyncio.get_running_loop().time() > deadline:
                 raise ConnectionError(
                     f"no live core owns partition {k}")
             await asyncio.sleep(0.2)
+
+    def note_route_failure(self, tenant: str, doc: str) -> None:
+        """A core refused the doc (``not the owner`` after a migration
+        this gateway missed): drop the cached route so the client's
+        reconnect resolves fresh instead of looping on the old owner."""
+        if self.routing is None:
+            return
+        from .stage_runner import doc_partition
+
+        self.routing.invalidate(doc_partition(tenant, doc,
+                                              self.placement.n))
 
     def upstream_send(self, obj: dict, up: Optional[_Upstream] = None
                       ) -> None:
@@ -381,6 +402,15 @@ class Gateway:
             raw = _encode_frame({"t": "signal", "signal": frame["signal"]})
             for session in self.topic_sessions.get(frame["topic"], ()):
                 session.push_raw(raw)
+        elif t == "fplacement":
+            # routing flip push: the core committed a migration; patch
+            # the cache in-memory (epoch-gated — a late push about an
+            # older epoch is ignored) so the reconnects triggered by the
+            # fdropped/teardown that follows resolve straight to the
+            # new owner without a table read
+            if self.routing is not None:
+                self.routing.note_epoch(int(frame["k"]), frame["addr"],
+                                        int(frame["epoch"]))
         elif t == "fdropped":
             # the core revoked this client's partition (lease moved):
             # close just that client; its auto-reconnect re-resolves the

@@ -229,6 +229,32 @@ class LocalServer:
         # otherwise it interleaves appends into a log the new owner is
         # already writing (the classic two-writer corruption).
         self.lease_fresh = None
+        # migration seal (placement_plane.MigrationEngine): submits are
+        # refused while the partition's state ships to the new owner —
+        # softer than revoke (reads/broadcasts still flow; the front end
+        # bounces submits on the retryable shed lane instead of erroring)
+        self._sealed = False
+        # epoch fence (deli admission): a callable returning the CURRENT
+        # table epoch when this server's claim epoch is stale, else None
+        self.epoch_fence = None
+
+    def seal(self) -> None:
+        """Migration fence point: refuse new submits (they bounce with a
+        retryable redirect) while the checkpoint ships to the target."""
+        self._sealed = True
+
+    def unseal(self) -> None:
+        self._sealed = False
+
+    @property
+    def sealed(self) -> bool:
+        return self._sealed
+
+    def doc_sequence_numbers(self) -> dict[str, int]:
+        """Fence seqs per live doc (``tenant/doc`` → deli seq) — exact
+        once sealed, since the ordering loop is single-threaded."""
+        return {key: o.deli.sequence_number
+                for key, o in self._orderers.items()}
 
     def revoke(self) -> None:
         """Partition lease lost (ShardHost.poll): stop sequencing NOW.
@@ -240,6 +266,8 @@ class LocalServer:
         if self._revoked or (self.lease_fresh is not None
                              and not self.lease_fresh()):
             raise RuntimeError("partition lease lost: reconnect")
+        if self._sealed:
+            raise RuntimeError("partition sealed for migration: reconnect")
 
     # ------------------------------------------------------------------ api
 
@@ -458,6 +486,11 @@ class LocalServer:
                 external_scribe=self.external_scribe,
                 on_version_persisted=on_persisted,
                 **kw)
+            # epoch fence: deli consults the server's CURRENT fence on
+            # every record (closure, so arming after boot still applies)
+            self._orderers[key].deli.epoch_fence = (
+                lambda: self.epoch_fence() if self.epoch_fence is not None
+                else None)
         return self._orderers[key]
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:

@@ -107,6 +107,26 @@ class PlacementDir:
             os.utime(self._path(k))
             return True
 
+    def transfer(self, k: int, from_owner: str, to_owner: str,
+                 to_address: str) -> bool:
+        """Migration handoff: atomically rewrite ``k``'s lease from
+        ``from_owner`` to ``to_owner`` under the claim flock. Unlike
+        release-then-claim there is NO unowned window a third core could
+        steal, and unlike ``try_claim`` it succeeds while the source's
+        lease is still FRESH — the source consents by naming itself.
+        Returns False (and changes nothing) if the lease is no longer
+        ``from_owner``'s (it crashed and was taken over mid-handoff)."""
+        with self._lock(k):
+            cur = self._read(k)
+            if cur is None or cur.get("owner") != from_owner:
+                return False
+            fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                       prefix=".lease-")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"owner": to_owner, "address": to_address}, f)
+            os.replace(tmp, self._path(k))
+            return True
+
     def release(self, k: int, owner_id: str) -> None:
         # same flock as try_claim/heartbeat: a release racing a takeover
         # must not unlink the NEW owner's lease after a stale read

@@ -1,0 +1,416 @@
+"""Placement control plane: epoch-numbered routing + live doc migration.
+
+Ref: memory-orderer/src/reservationManager.ts (lease reservations) and
+the Kafka partition-reassignment protocol the reference inherits for
+free — here an explicit subsystem over the flock-leased
+``PlacementDir`` (service/placement.py):
+
+- :class:`EpochTable` — a shard-dir routing table (``placement/
+  table.json``) stamped with a monotone global epoch. Every ownership
+  change (claim, release, migration transfer) bumps the epoch under one
+  flock, so ANY two table states are ordered and a router can discard
+  stale routes by comparing integers instead of re-reading leases.
+- :class:`RoutingCache` — the gateway-side view: an in-memory dict on
+  the hot path (no per-request lease reads), refreshed from the epoch
+  table on miss and PATCHED by ``fplacement`` pushes from the cores on
+  migration; an older epoch can never overwrite a newer route.
+- :class:`MigrationEngine` — moves a live partition between cores
+  without losing, duplicating or reordering a single op: seal → fence →
+  checkpoint → handoff (atomic lease transfer, no unowned window) →
+  epoch bump. In-flight submits bounce on the shed-retry lane (PR 7) and
+  resubmit in client-sequence order against the new owner.
+
+Fencing is layered: the seal refuses submits at the front door, the
+lease-freshness clock refuses a stalled ex-owner, and deli's admission
+refuses any record whose partition epoch is older than the table's
+(``DeliLambda.epoch_fence``) — a doc mid-migration is sequenced by
+exactly one core, provably.
+
+The engine's ``fault_plane`` seam (class attribute, ``None`` by
+default, same duck-typing as service/partitions.py) exposes the three
+crash windows the chaos campaign kills: ``placement.pre_fence``,
+``placement.pre_handoff``, ``placement.post_handoff``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import tempfile
+import time
+from typing import Callable, Optional
+
+from ..obs import tier_counters
+from .placement import PlacementDir
+
+#: subdirectory of the shard dir holding the routing table
+TABLE_DIRNAME = "placement"
+
+_SHARED_COUNTERS = None
+
+
+def placement_counters():
+    """The module-held placement ``Counters`` for per-event seam call
+    sites (deli's epoch fence, the front end's redirect bounces). Those
+    sites must not mint a fresh ``tier_counters`` instance per event:
+    the metrics registry tracks instances weakly, and a temporary dies
+    before the next scrape ever sees its counts."""
+    global _SHARED_COUNTERS
+    if _SHARED_COUNTERS is None:
+        _SHARED_COUNTERS = tier_counters("placement")
+    return _SHARED_COUNTERS
+
+
+def _flock(path: str):
+    @contextlib.contextmanager
+    def held():
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+    return held()
+
+
+class EpochTable:
+    """Epoch-stamped doc→core routing table, one JSON file per shard dir.
+
+    The table is a routing VIEW with total epoch order; the lease
+    directory stays the liveness truth. A reader holding a stale table
+    falls back to a lease read (``RoutingCache.refresh``), so a crash
+    between a lease claim and the table write is merely a cache miss,
+    never a wrong route that sticks.
+    """
+
+    def __init__(self, directory: str, counters=None):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "table.json")
+        self._lock_path = os.path.join(directory, "table.lock")
+        self.counters = (counters if counters is not None
+                         else tier_counters("placement"))
+        self._cache: Optional[dict] = None
+        self._cache_stamp = None
+
+    @classmethod
+    def for_shard_dir(cls, shard_dir: str, counters=None) -> "EpochTable":
+        return cls(os.path.join(shard_dir, TABLE_DIRNAME), counters=counters)
+
+    # ------------------------------------------------------------- readers
+
+    def read(self) -> dict:
+        """Current table (mtime-cached): ``{"epoch": N, "parts":
+        {"<k>": {"owner", "addr", "epoch"}}}``."""
+        try:
+            st = os.stat(self.path)
+            stamp = (st.st_ino, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return {"epoch": 0, "parts": {}}
+        if self._cache is not None and stamp == self._cache_stamp:
+            return self._cache
+        rec = self._read_fresh()
+        self._cache, self._cache_stamp = rec, stamp
+        return rec
+
+    def _read_fresh(self) -> dict:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {"epoch": 0, "parts": {}}
+
+    def global_epoch(self) -> int:
+        return self.read()["epoch"]
+
+    def epoch_of(self, k: int) -> int:
+        part = self.read()["parts"].get(str(k))
+        return part["epoch"] if part else 0
+
+    def addr_of(self, k: int) -> Optional[str]:
+        part = self.read()["parts"].get(str(k))
+        return part["addr"] if part else None
+
+    def part_epochs(self) -> dict[int, int]:
+        """``{k: epoch}`` for every routed partition — the ShardHost
+        refreshes its in-memory fence view from this once per poll."""
+        return {int(k): p["epoch"]
+                for k, p in self.read()["parts"].items()}
+
+    # ------------------------------------------------------------- writers
+
+    def _write(self, rec: dict) -> None:
+        d = os.path.dirname(self.path)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".table-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, self.path)
+
+    def record_claim(self, k: int, owner: str, addr: str) -> int:
+        """Record that ``owner@addr`` now serves partition ``k`` (initial
+        claim, takeover, or migration adoption). Returns the new epoch."""
+        with _flock(self._lock_path):
+            rec = self._read_fresh()
+            rec["epoch"] += 1
+            rec["parts"][str(k)] = {
+                "owner": owner, "addr": addr, "epoch": rec["epoch"]}
+            self._write(rec)
+        self.counters.inc("placement.epoch.bumps")
+        return rec["epoch"]
+
+    def record_release(self, k: int, owner: str) -> Optional[int]:
+        """Drop ``k``'s route if ``owner`` still holds it; the bump makes
+        the removal itself ordered (a cached route older than the release
+        epoch is discardable)."""
+        with _flock(self._lock_path):
+            rec = self._read_fresh()
+            part = rec["parts"].get(str(k))
+            if part is None or part["owner"] != owner:
+                return None
+            rec["epoch"] += 1
+            del rec["parts"][str(k)]
+            self._write(rec)
+        self.counters.inc("placement.epoch.bumps")
+        return rec["epoch"]
+
+
+class RoutingCache:
+    """Gateway-side doc→core routing: dict lookup on the hot path.
+
+    Replaces per-request ``PlacementDir.owner_of`` reads. Misses refresh
+    from the epoch table (one mtime-cached file read), falling back to a
+    lease read for partitions the table hasn't seen; ``fplacement``
+    pushes from the cores patch entries the moment a migration commits.
+    Epoch-stamped invalidation: an update only lands if its epoch is
+    newer than the cached one, so a delayed push about yesterday's owner
+    cannot clobber today's route.
+    """
+
+    def __init__(self, placement: PlacementDir, table: EpochTable,
+                 counters=None):
+        self.placement = placement
+        self.table = table
+        self.counters = (counters if counters is not None
+                         else tier_counters("placement"))
+        self.addrs: dict[int, Optional[str]] = {}
+        self.epochs: dict[int, int] = {}
+
+    def resolve(self, k: int) -> Optional[str]:
+        addr = self.addrs.get(k)
+        if addr is not None:
+            self.counters.inc("placement.cache.hits")
+            return addr
+        return self.refresh(k)
+
+    def refresh(self, k: int) -> Optional[str]:
+        """Re-read ``k``'s route: epoch table first, lease directory as
+        the liveness fallback (covers the claim→table-write crash gap and
+        pre-epoch-table deployments)."""
+        self.counters.inc("placement.cache.refreshes")
+        part = self.table.read()["parts"].get(str(k))
+        if part is not None and part["epoch"] >= self.epochs.get(k, 0):
+            self._store(k, part["addr"], part["epoch"])
+            return part["addr"]
+        addr = self.placement.owner_of(k)
+        if addr is not None:
+            self._store(k, addr, self.epochs.get(k, 0))
+        return addr
+
+    def note_epoch(self, k: int, addr: Optional[str], epoch: int) -> bool:
+        """Apply a pushed route (``fplacement``) iff it is newer than the
+        cached epoch. Returns True when the route changed."""
+        if epoch <= self.epochs.get(k, 0):
+            return False
+        self._store(k, addr, epoch)
+        return True
+
+    def invalidate(self, k: int) -> None:
+        """Dial failure against the cached address: drop the route (the
+        epoch stays, so only a NEWER route can repopulate via push)."""
+        self.addrs.pop(k, None)
+        self.counters.inc("placement.cache.invalidations")
+
+    def _store(self, k: int, addr: Optional[str], epoch: int) -> None:
+        if addr is None:
+            self.addrs.pop(k, None)
+        else:
+            self.addrs[k] = addr
+        self.epochs[k] = epoch
+
+
+class MigrationEngine:
+    """Live migration of one partition between two cores.
+
+    Source-side protocol (:meth:`migrate`):
+
+    1. **seal** — the source's LocalServer refuses new submits; the front
+       end bounces them on the shed-retry lane (echoed op +
+       ``retry_after_ms``), so drivers park and resubmit in cseq order.
+    2. **fence** — record each live doc's deli sequence number; the
+       ordering loop is single-threaded, so after the seal nothing new
+       can be ticketed and these are exact.
+    3. **checkpoint** — ``checkpoint_all`` + durable-log flush: the deli/
+       scribe state the target resumes from (the same machinery
+       partitions.py uses for crash recovery). The raw-log tail past the
+       checkpoint replays idempotently on the target.
+    4. **handoff** — the target adopts: atomic lease TRANSFER under the
+       partition flock (owner rewritten in place — no unowned window a
+       third core could steal), epoch-table claim, server rebuild.
+    5. **flip** — the source pushes the new route (``fplacement``) and
+       drops the partition's sessions; clients reconnect and land on the
+       target via the refreshed routing cache.
+
+    A source crash anywhere in this sequence is the chaos campaign's
+    subject: before the fence the migration simply never happened (lease
+    TTL takeover recovers); after the handoff the target already owns the
+    log. The engine never holds both cores' state — the target side is
+    :meth:`adopt`, reachable in-proc (tests, chaos) or over the admin
+    plane (``admin_adopt_partition``).
+    """
+
+    #: chaos seam (duck-typed FaultPlane), None when disarmed
+    fault_plane = None
+
+    def __init__(self, host, counters=None):
+        # ``host`` is duck-typed (front_end.ShardHost): owner_id, address,
+        # placement, table, servers, hb_times, claim_epochs, table_epochs,
+        # migrating, _make_server(k)
+        self.host = host
+        self.counters = (counters if counters is not None
+                         else tier_counters("placement"))
+
+    # -------------------------------------------------------------- source
+
+    def migrate(self, k: int, target_addr: str,
+                adopt: Optional[Callable[[int, str], dict]] = None,
+                on_flip: Optional[Callable] = None) -> dict:
+        """Move partition ``k`` from this host to ``target_addr``.
+
+        ``adopt(k, from_owner)`` performs the target side; defaults to an
+        ``admin_adopt_partition`` RPC against ``target_addr``. ``on_flip``
+        (if given) runs after the epoch bump with ``(k, target_addr,
+        epoch, server)`` — the front end uses it to push ``fplacement``
+        and drop the partition's live sessions.
+        """
+        host = self.host
+        server = host.servers.get(k)
+        if server is None:
+            raise RuntimeError(f"not the owner of partition {k}")
+        if k in host.migrating:
+            raise RuntimeError(f"partition {k} already migrating")
+        host.migrating.add(k)
+        try:
+            if self.fault_plane is not None:
+                self.fault_plane("placement.pre_fence", k=k)
+            # 1. seal: submits bounce from here on (front-end shed nacks)
+            server.seal()
+            # 2. fence seqs: drain queued raw records first, then they are
+            # exact — sealed + single-threaded means nothing is in flight
+            server.drain()
+            fences = server.doc_sequence_numbers()
+            # 3. checkpoint + flush: the state the target resumes from
+            server.checkpoint_all()
+            flush = getattr(server.log, "flush", None)
+            if flush is not None:
+                flush()
+            self.counters.inc("placement.migration.fences")
+            if self.fault_plane is not None:
+                self.fault_plane("placement.pre_handoff", k=k)
+            # stop heartbeating/serving k BEFORE the transfer: the lease
+            # stays ours (fresh) until the target rewrites it in place
+            host.hb_times.pop(k, None)
+            host.servers.pop(k, None)
+            server.revoke()
+            # 4. handoff: the target transfers the lease + claims the epoch
+            do_adopt = adopt if adopt is not None else self._rpc_adopt
+            try:
+                result = do_adopt(k, target_addr)
+            except Exception:
+                self._reclaim(k)
+                raise
+            if self.fault_plane is not None:
+                # the "source dies during target replay" window: the
+                # target owns the lease + epoch; the source merely fails
+                # to push the flip (clients discover via reconnect)
+                self.fault_plane("placement.post_handoff", k=k)
+            epoch = result["epoch"]
+            self.counters.inc("placement.migration.committed")
+            # 5. flip: push the new route, drop the sealed sessions
+            if on_flip is not None:
+                on_flip(k, target_addr, epoch, server)
+            return {"k": k, "target": target_addr, "epoch": epoch,
+                    "fences": fences}
+        finally:
+            host.migrating.discard(k)
+
+    def _reclaim(self, k: int) -> None:
+        """Adoption failed before the lease moved: the lease is still
+        ours, so rebuild the partition server and resume serving."""
+        host = self.host
+        self.counters.inc("placement.migration.failed")
+        if host.placement.try_claim(k, host.owner_id, host.address):
+            host.claim_epochs[k] = host.table.record_claim(
+                k, host.owner_id, host.address)
+            host.servers[k] = host._make_server(k)
+            host.hb_times[k] = time.monotonic()
+
+    def _rpc_adopt(self, k: int, target_addr: str) -> dict:
+        """Default target-side handoff: one blocking admin RPC against the
+        target core (uniform deployments share the admin secret)."""
+        host_s, _, port_s = target_addr.rpartition(":")
+        frame = {"t": "admin_adopt_partition", "k": k,
+                 "from_owner": self.host.owner_id}
+        secret = getattr(self.host, "admin_secret", None)
+        if secret:
+            frame["secret"] = secret
+        return admin_rpc(host_s or "127.0.0.1", int(port_s), frame)
+
+    # -------------------------------------------------------------- target
+
+    def adopt(self, k: int, from_owner: str) -> dict:
+        """Target side: take over ``k`` from ``from_owner`` and resume its
+        pipeline from the shipped checkpoint + idempotent raw-log tail."""
+        host = self.host
+        if not host.placement.transfer(k, from_owner, host.owner_id,
+                                       host.address):
+            raise RuntimeError(
+                f"partition {k} not transferable from {from_owner}")
+        epoch = host.table.record_claim(k, host.owner_id, host.address)
+        host.claim_epochs[k] = epoch
+        host.table_epochs[k] = epoch
+        server = host._make_server(k)
+        host.servers[k] = server
+        host.hb_times[k] = time.monotonic()
+        self.counters.inc("placement.migration.adopted")
+        return {"epoch": epoch}
+
+
+def admin_rpc(host: str, port: int, frame: dict,
+              timeout: float = 30.0) -> dict:
+    """One rid-matched admin RPC round trip (length-prefixed JSON — the
+    same wire shape bench.py and the admin CLI use)."""
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        body = json.dumps(dict(frame, rid=1)).encode()
+        s.sendall(len(body).to_bytes(4, "big") + body)
+
+        def read_exactly(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("closed")
+                buf += chunk
+            return buf
+
+        while True:
+            n = int.from_bytes(read_exactly(4), "big")
+            reply = json.loads(read_exactly(n).decode())
+            if reply.get("rid") != 1:
+                continue
+            if reply.get("t") == "error":
+                raise RuntimeError(reply.get("message"))
+            return reply

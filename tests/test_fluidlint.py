@@ -324,13 +324,25 @@ def test_snapshot_family_lock_caught(tmp_path):
         [str(v) for v in vs]
 
 
+def test_placement_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('placement.migration.commited')\n")  # typo'd member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "placement.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "placement.migration.committed" in vs[0].message
+
+
 def test_boot_family_members_pass(tmp_path):
     path = _metrics_file(
         tmp_path,
         "def f(c):\n"
         "    c.inc('boot.snapshot.used')\n"
         "    c.inc('boot.backfill.bounded')\n"
-        "    c.inc('storage.snapshot.served')\n")
+        "    c.inc('storage.snapshot.served')\n"
+        "    c.inc('placement.epoch.bumps')\n")
     assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
 
 

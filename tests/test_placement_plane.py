@@ -1,0 +1,348 @@
+"""Placement control plane: epoch table ordering, deli's stale-epoch
+fence, migration equivalence, the double-owner race, and the driver's
+transparent redirect-retry lane during a live migration.
+
+Ref: memory-orderer/src/reservationManager.ts is the lease analog; the
+epoch-numbered routing table and the seal → fence → checkpoint →
+atomic-handoff protocol are ours (service/placement_plane.py,
+ARCHITECTURE.md "Placement & migration").
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.chaos.migrate import (
+    MigrateClient,
+    _doc_for_partition,
+    _log_fingerprint,
+)
+from fluidframework_tpu.chaos.monitor import InvariantMonitor
+from fluidframework_tpu.chaos.soak import _replica_fingerprint
+from fluidframework_tpu.obs import tier_snapshot
+from fluidframework_tpu.protocol.messages import (
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.service.front_end import ShardHost
+from fluidframework_tpu.service.placement import PlacementDir
+from fluidframework_tpu.service.placement_plane import (
+    EpochTable,
+    MigrationEngine,
+    RoutingCache,
+)
+from fluidframework_tpu.service.stage_runner import doc_partition
+from fluidframework_tpu.utils.telemetry import Counters
+
+TENANT = "chaos"
+
+
+def wait_for(pred, timeout: float = 20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+def _op(cseq: int, ref_seq: int = 0) -> DocumentMessage:
+    return DocumentMessage(
+        client_sequence_number=cseq, reference_sequence_number=ref_seq,
+        type=MessageType.OPERATION, contents={"i": cseq})
+
+
+def _host(shard_dir, prefer=(), n=2, ttl_s=30.0) -> ShardHost:
+    h = ShardHost(str(shard_dir), n, prefer=prefer, ttl_s=ttl_s)
+    h.address = f"inproc/{h.owner_id}"
+    h.poll()
+    return h
+
+
+def _close(*hosts) -> None:
+    for h in hosts:
+        for s in list(h.servers.values()):
+            s.log.close()
+
+
+# ----------------------------------------------------------- epoch table
+
+
+def test_epoch_monotonicity_and_cache_ordering(tmp_path):
+    """Every ownership change bumps the global epoch; a routing cache
+    holding epoch E refuses any push older than E, in any order."""
+    table = EpochTable(str(tmp_path / "placement"))
+    e1 = table.record_claim(0, "a", "addr-a")
+    e2 = table.record_claim(1, "a", "addr-a")
+    e3 = table.record_claim(0, "b", "addr-b")  # migration adoption
+    assert e1 < e2 < e3
+    assert table.epoch_of(0) == e3 and table.addr_of(0) == "addr-b"
+    e4 = table.record_release(1, "a")
+    assert e4 > e3 and table.addr_of(1) is None
+    # release by a non-owner is a no-op (no bump, no route change)
+    assert table.record_release(0, "a") is None
+    assert table.global_epoch() == e4
+
+    cache = RoutingCache(PlacementDir(str(tmp_path / "placement"), 2, 1.0),
+                         table)
+    assert cache.resolve(0) == "addr-b"
+    # a delayed push about yesterday's owner cannot clobber the route
+    assert cache.note_epoch(0, "addr-a", e1) is False
+    assert cache.resolve(0) == "addr-b"
+    assert cache.note_epoch(0, "addr-c", e4 + 1) is True
+    assert cache.resolve(0) == "addr-c"
+    # invalidation drops the address but keeps the epoch floor
+    cache.invalidate(0)
+    assert cache.note_epoch(0, "addr-a", e1) is False
+    assert cache.note_epoch(0, "addr-d", e4 + 2) is True
+    assert cache.resolve(0) == "addr-d"
+
+
+# ------------------------------------------------------ stale-epoch fence
+
+
+def test_stale_epoch_submit_refused(tmp_path):
+    """Deli's admission refuses a record whose partition epoch is older
+    than the table's: nacked with the CURRENT epoch, nothing sequenced,
+    offset consumed — the ex-owner can never extend the log."""
+    sh = _host(tmp_path, prefer=(0, 1))
+    try:
+        k = doc_partition("t1", "doc-x", 2)
+        server = sh.servers[k]
+        conn = server.connect("t1", "doc-x")
+        nacks = []
+        conn.on_nack = nacks.append
+        conn.submit([_op(1)])
+        server.drain()
+        assert not nacks
+        seq_before = server.doc_sequence_numbers()["t1/doc-x"]
+        assert seq_before >= 2  # join + the op
+
+        before = tier_snapshot("placement").get(
+            "placement.epoch.stale_nacks", 0)
+        # another core adopts the partition behind this host's back;
+        # the once-per-poll table refresh arms the fence
+        current = sh.table.record_claim(k, "other-core", "inproc/other")
+        sh.table_epochs = sh.table.part_epochs()
+        conn.submit([_op(2)])
+        server.drain()
+
+        assert len(nacks) == 1
+        nack = nacks[0]
+        assert nack.code == 410
+        assert f"epoch {current}" in nack.message
+        assert nack.operation.client_sequence_number == 2
+        assert server.doc_sequence_numbers()["t1/doc-x"] == seq_before
+        assert tier_snapshot("placement").get(
+            "placement.epoch.stale_nacks", 0) == before + 1
+    finally:
+        _close(sh)
+
+
+# ------------------------------------------------- migration equivalence
+
+
+def _edit_stream(tmp_path, migrate_rounds):
+    """Seeded two-client edit stream over partition 0, migrated between
+    two cores at the given rounds. Returns the converged text."""
+    a = _host(tmp_path, prefer=(0, 1))
+    b = _host(tmp_path)
+    hosts = [a, b]
+    doc = _doc_for_partition(0, 2)
+    counters = Counters()
+    monitor = InvariantMonitor(counters)
+
+    def owner():
+        for h in hosts:
+            s = h.servers.get(0)
+            if s is not None and not s.sealed:
+                return s
+        return None
+
+    def drain_all():
+        for h in hosts:
+            for s in list(h.servers.values()):
+                s.drain()
+
+    clients = [MigrateClient(doc, owner, monitor, counters,
+                             random.Random(77 + i)) for i in range(2)]
+    try:
+        for c in clients:
+            assert c.connect()
+        drain_all()
+        for rnd in range(30):
+            for c in clients:
+                if c.conn is None or c.severed:
+                    assert c.reconnect()
+            drain_all()
+            for c in clients:
+                c.edit(2)
+            drain_all()
+            if rnd in migrate_rounds:
+                src = next(h for h in hosts if 0 in h.servers)
+                tgt = next(h for h in hosts if h is not src)
+                res = MigrationEngine(src).migrate(
+                    0, tgt.address,
+                    adopt=lambda k, addr, s=src, t=tgt:
+                    MigrationEngine(t).adopt(k, s.owner_id))
+                assert res["target"] == tgt.address
+                # the real deployment drops the partition's sessions on
+                # the flip; sever so the next round rejoins the target
+                for c in clients:
+                    c.sever()
+        for _ in range(10):
+            drain_all()
+            if all(c.settled for c in clients):
+                break
+            for c in clients:
+                if not c.settled:
+                    c.reconnect()
+        drain_all()
+        for c in clients:
+            c.catch_up()
+        final = owner()
+        # offline replay: the whole multi-owner history from offset 0
+        monitor.attach(final.log, f"deltas/{TENANT}/{doc}")
+        final.drain()
+        fps = {i: _replica_fingerprint(c.replica)
+               for i, c in enumerate(clients)}
+        fps["oracle"] = _log_fingerprint(final, doc)
+        assert len(set(fps.values())) == 1, fps
+        monitor.check_quiescent({str(k): v for k, v in fps.items()})
+        return clients[0].replica.get_text()
+    finally:
+        _close(*hosts)
+
+
+def test_migration_equivalence_fuzz(tmp_path):
+    """The same seeded edit stream produces the SAME document whether
+    the partition stayed put or migrated A→B and back mid-stream: the
+    target resumes from the checkpoint + idempotent raw-log tail with
+    nothing lost, duplicated, or reordered."""
+    migrated = _edit_stream(tmp_path / "migrated", {9, 19})
+    control = _edit_stream(tmp_path / "control", set())
+    assert migrated == control
+    assert len(control) > 20
+
+
+# ------------------------------------------------------ double-owner race
+
+
+def test_double_owner_race_exactly_one_sequences(tmp_path):
+    """Two cores race to adopt the same partition: the flocked lease
+    transfer admits exactly one, and the dispossessed ex-owner's next
+    submit is refused by the epoch fence — never sequenced twice."""
+    a = _host(tmp_path, prefer=(0,), n=1)
+    b = _host(tmp_path, n=1)
+    c = _host(tmp_path, n=1)
+    try:
+        conn = a.servers[0].connect("t1", "doc-r")
+        conn.submit([_op(1)])
+        a.servers[0].drain()
+
+        winners, barrier = [], threading.Barrier(2)
+
+        def race(host):
+            barrier.wait()
+            try:
+                winners.append((host, MigrationEngine(host).adopt(
+                    0, a.owner_id)))
+            except RuntimeError:
+                pass  # lost the transfer race
+
+        threads = [threading.Thread(target=race, args=(h,)) for h in (b, c)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(winners) == 1
+        winner = winners[0][0]
+        loser = b if winner is c else c
+        assert 0 in winner.servers and 0 not in loser.servers
+
+        # the winner sequences; the ex-owner's fence refuses
+        wconn = winner.servers[0].connect("t1", "doc-r")
+        wconn.submit([_op(1)])
+        winner.servers[0].drain()
+        nacks = []
+        conn.on_nack = nacks.append
+        a.table_epochs = a.table.part_epochs()  # ex-owner's poll refresh
+        conn.submit([_op(2)])
+        a.servers[0].drain()
+        assert len(nacks) == 1 and nacks[0].code == 410
+        # the refused op is NOT in the authoritative log: only the
+        # winner's server advanced past the fence point
+        assert (winner.servers[0].doc_sequence_numbers()["t1/doc-r"]
+                > a.servers[0].doc_sequence_numbers()["t1/doc-r"])
+    finally:
+        _close(a, b, c)
+
+
+# ------------------------------------------- driver redirect retry order
+
+
+def test_driver_redirect_retry_preserves_cseq_order(tmp_path):
+    """Submits hitting a sealed partition bounce with a retryable
+    redirect; the driver parks them on the shed-retry lane and resubmits
+    transparently AFTER the flip — every op acked exactly once, in
+    client-sequence order, with no app-visible nack."""
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.service.front_end import NetworkFrontEnd
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    front = NetworkFrontEnd(LocalServer()).start_background()
+    factory = NetworkDocumentServiceFactory("127.0.0.1", front.port)
+    try:
+        conn = factory.create_document_service(
+            "t", "doc-m").connect_to_delta_stream()
+        acked, hard = {}, []
+        conn.on_op = lambda m: (
+            m.client_id == conn.client_id
+            and acked.__setitem__(m.client_sequence_number,
+                                  m.sequence_number))
+        conn.on_nack = hard.append
+
+        conn.submit([_op(1), _op(2)])
+        assert wait_for(lambda: len(acked) == 2)
+
+        placement_redirects = tier_snapshot("placement").get(
+            "placement.submits.redirected", 0)
+        front.server.seal()
+        snap = factory.counters.snapshot
+        shed_before = snap().get("driver.submit.shed_retries", 0)
+        conn.submit([_op(c) for c in range(3, 13)])
+        assert wait_for(
+            lambda: snap().get("driver.submit.shed_retries", 0)
+            > shed_before)
+        assert len(acked) == 2  # nothing sequenced through the seal
+        assert tier_snapshot("placement").get(
+            "placement.submits.redirected", 0) > placement_redirects
+
+        front.server.unseal()
+        assert wait_for(lambda: len(acked) == 12, timeout=30.0)
+        assert not hard, f"hard nack leaked: {hard[0]}"
+        # the retry lane preserved submission order across the flip
+        seqs = [acked[cs] for cs in range(3, 13)]
+        assert seqs == sorted(seqs)
+        conn.close()
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------- campaign smoke run
+
+
+def test_chaos_migrate_quick_campaign():
+    """The chaos migration campaign's own verdict machinery, quick
+    variant: one source-crash recovery + one clean migration, replayed
+    through the invariant monitor."""
+    from fluidframework_tpu.chaos.migrate import run_campaign
+
+    result = run_campaign(11, Counters(), quick=True)
+    assert result["recoveries"] == 1
+    assert result["placement"]["placement.migration.committed"] >= 1
+    assert result["sequenced"]["doc0"] > 20

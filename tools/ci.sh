@@ -46,6 +46,9 @@ if [ "$run_soak" = 1 ]; then
     echo "--- noisy-neighbor overload scenario (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.noisy --seed 0 --quick
     echo "noisy: ok"
+    echo "--- chaos migration campaign (fixed seed, quick)"
+    python -m fluidframework_tpu.chaos.migrate --seed 0 --quick
+    echo "migrate: ok"
 fi
 
 echo "ci: all gates passed"

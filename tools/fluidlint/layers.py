@@ -92,7 +92,8 @@ LAYER_DOC = {
     "loader": "container boot, delta manager, quorum",
     "driver": "local / network / file drivers (wire transport)",
     "framework": "aqueduct: DataObject, undo-redo, interceptions",
-    "service": "deli, scriptorium, scribe, TPU applier, front end",
+    "service": "deli, scriptorium, scribe, TPU applier, front end, "
+               "placement control plane",
     "native": "C++ durable op log + chunk store bindings",
     "replay": "replay tool + snapshot-regression corpus",
     "chaos": "deterministic fault injection + convergence invariant monitor",

@@ -69,6 +69,19 @@ LOCKED_FAMILIES = {
                                     "storage.snapshot.legacy_tree",
                                     "storage.snapshot.chunks_written",
                                     "storage.snapshot.chunks_reused"}),
+    # the placement control plane: the net-smoke migration gate, the
+    # admin CLI, and the chaos migration campaign key on these exact
+    # names (service/placement_plane.py)
+    "placement.": frozenset({"placement.epoch.bumps",
+                             "placement.epoch.stale_nacks",
+                             "placement.cache.hits",
+                             "placement.cache.refreshes",
+                             "placement.cache.invalidations",
+                             "placement.submits.redirected",
+                             "placement.migration.fences",
+                             "placement.migration.committed",
+                             "placement.migration.failed",
+                             "placement.migration.adopted"}),
 }
 
 

@@ -30,7 +30,12 @@ amortization points of the socket tier (see ARCHITECTURE.md
 - a mini-overload burst with the admission gate + a hair-trigger SLO
   armed — ``net.admission.shed`` must rise, ``obs.slo.state`` must
   appear in the scrape, and the driver's transparent shed retries must
-  converge once shedding is disarmed.
+  converge once shedding is disarmed;
+- a forced live migration under traffic (two sharded core processes +
+  a gateway, ``admin_migrate_doc`` fired mid-stream): every submitted
+  op must ack exactly once (zero lost), and the source core's
+  ``placement.migration.committed`` / ``placement.epoch.bumps``
+  counters must be nonzero.
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -63,6 +68,141 @@ def wait_for(pred, timeout: float = 20.0) -> bool:
 def _frame(obj: dict) -> bytes:
     body = json.dumps(obj, separators=(",", ":")).encode()
     return len(body).to_bytes(4, "big") + body
+
+
+def _spawn_listening(mod: str, *args: str):
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+def migration_gate() -> dict:
+    """Forced live migration under traffic: two sharded core processes
+    + a gateway, a driver client submitting through the migration, the
+    ``admin migrate`` RPC fired at the source core mid-stream. Returns
+    the placement counter checks; raises AssertionError on a lost or
+    duplicated ack (the zero-loss gate)."""
+    import tempfile
+    import threading
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+        _Transport,
+    )
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.stage_runner import doc_partition
+
+    shard_dir = tempfile.mkdtemp(prefix="net-smoke-mig-")
+    cores, core_ports, gw = [], [], None
+    writer = reader = None
+    try:
+        for prefer in ("0", "1"):
+            c, p = _spawn_listening(
+                "fluidframework_tpu.service.front_end", "--port", "0",
+                "--shard-dir", shard_dir, "--shards", "2",
+                "--prefer", prefer, "--lease-ttl", "1.5")
+            cores.append(c)
+            core_ports.append(p)
+        gw, gw_port = _spawn_listening(
+            "fluidframework_tpu.service.gateway", "--shard-dir",
+            shard_dir, "--shards", "2")
+
+        k = doc_partition("smoke", "migdoc", 2)
+        src_port = core_ports[k]
+        target = f"127.0.0.1:{core_ports[1 - k]}"
+
+        # the supported client posture for a route flip: the gateway
+        # drops the doc's sessions on fdropped, the container re-dials
+        # and replays its pending ops against the takeover owner
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", gw_port), auto_reconnect=True).resolve(
+            "smoke", "migdoc")
+        sstr = writer.runtime.create_data_store(
+            "default").create_channel("text", "shared-string")
+
+        n_ops = 120
+
+        def feed():
+            for i in range(n_ops):
+                sstr.insert_text(0, f"m{i:03d} ")
+                time.sleep(0.005)
+
+        feeder = threading.Thread(target=feed)
+        feeder.start()
+        try:
+            # let traffic establish, then rip the partition out from
+            # under it mid-stream — the synchronous RPC returns after
+            # the flip, while the feeder keeps submitting through it
+            if not wait_for(lambda: len(sstr.get_text()) >= 60):
+                raise AssertionError("migration gate: no traffic before "
+                                     "the trigger")
+            t = _Transport("127.0.0.1", src_port, timeout=30.0)
+            try:
+                mig = t.request({"t": "admin_migrate_doc",
+                                 "tenant": "smoke", "doc": "migdoc",
+                                 "target": target})
+                assert mig["target"] == target, mig
+                place = t.request({"t": "admin_placement"})["placement"]
+            finally:
+                t.close()
+        finally:
+            feeder.join()
+        # zero lost acks: every edit submitted across the flip must land
+        # exactly once (pending-op replay through the takeover owner)
+        if not wait_for(lambda: writer.connected
+                        and writer.runtime.pending.count == 0,
+                        timeout=60.0):
+            raise AssertionError(
+                f"migration gate: {writer.runtime.pending.count} op(s) "
+                "still pending after the flip (acks lost)")
+        reader = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", gw_port)).resolve("smoke", "migdoc")
+        if not wait_for(
+                lambda: "text" in reader.runtime.get_data_store(
+                    "default").channels
+                and len(reader.runtime.get_data_store("default")
+                        .get_channel("text").get_text())
+                == len(sstr.get_text())):
+            raise AssertionError(
+                "migration gate: reader never converged on the writer's "
+                "text after the flip")
+        text = reader.runtime.get_data_store(
+            "default").get_channel("text").get_text()
+        lost = [i for i in range(n_ops) if text.count(f"m{i:03d} ") != 1]
+        if lost:
+            raise AssertionError(
+                f"migration gate: {len(lost)} edit(s) lost or duplicated "
+                f"across the flip (first: {lost[:5]})")
+        counters = place["counters"]
+        return {
+            "placement.migration.committed": counters.get(
+                "placement.migration.committed", 0),
+            "placement.epoch.bumps": counters.get(
+                "placement.epoch.bumps", 0),
+        }
+    finally:
+        for cont in (writer, reader):
+            if cont is not None:
+                try:
+                    cont.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        if gw is not None:
+            gw.terminate()
+        for c in cores:
+            c.terminate()
+        for c in cores:
+            try:
+                c.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                c.kill()
 
 
 def main() -> int:
@@ -365,6 +505,14 @@ def main() -> int:
     conn2.close()
     s.close()
     front.stop()
+
+    # forced live migration under traffic (its own 2-core + gateway
+    # process topology): zero lost acks, placement counters nonzero
+    try:
+        checks.update(migration_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
 
     print(json.dumps({"checks": checks,
                       "hop_counts": hop_counts,
