@@ -386,10 +386,22 @@ def _schedule_phase_a(plane: FaultPlane) -> None:
     plane.rule("broadcast.publish", "drop", every=10, times=2)
     plane.rule("broadcast.publish", "dup", every=7, times=2)
     plane.rule("applier.dispatch", "force_wide", at=1)
-    plane.rule("applier.ingest", "escalate_host", at=6)
+    # escalation late enough (ingest consult ~26 ≈ round 8 of quick's 10)
+    # that the overlap-window crash rules below see the doc still on the
+    # DEVICE lane — the earlier at=6 escalated the soak's single doc to
+    # host in round 1 and starved every later dispatch seam
+    plane.rule("applier.ingest", "escalate_host", at=26)
     plane.rule("stage.pre_checkpoint", "crash", at=3)
     plane.rule("stage.post_checkpoint", "crash", at=5)
     plane.rule("stage.crash", "orderer_hard", at=4)
+    # overlap-window crashes, BOTH orders: "staged" kills the stage host
+    # after wave N+1 is staged (device buffers resident, step not issued)
+    # — restore must replay exactly that unexecuted wave; "inflight"
+    # kills it after wave N's step is issued but before the next wave
+    # stages — the restored farm reloads the last durable checkpoint and
+    # skip-by-seq absorbs the already-applied window (no double-apply)
+    plane.rule("applier.stage.staged", "crash", at=2)
+    plane.rule("applier.stage.inflight", "crash", at=3)
 
 
 def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
@@ -897,6 +909,13 @@ def _cross_check(counters: Counters) -> None:
         ("chaos.injected.stage.pre_checkpoint",
          "chaos.recovered.stage_restart"),
         ("chaos.injected.stage.post_checkpoint",
+         "chaos.recovered.stage_restart"),
+        # overlap-window crashes (both orders) recover through the same
+        # checkpoint+replay restart path — dropping either seam or its
+        # recovery would open a silent hole in the stage/execute split
+        ("chaos.injected.applier.stage.staged",
+         "chaos.recovered.stage_restart"),
+        ("chaos.injected.applier.stage.inflight",
          "chaos.recovered.stage_restart"),
         ("chaos.injected.stage.crash", "chaos.recovered.orderer_restart"),
         ("chaos.injected.net.send.truncate",

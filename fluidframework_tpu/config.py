@@ -35,9 +35,22 @@ class Config:
     applier_overflow_check_every: int = 64  # dispatches between fences
     # use the Pallas VMEM-resident apply (ops/pallas_apply.py) in the
     # applier's dense step (requires max_docs % 8 == 0; measured ~8%
-    # faster than the XLA scan on TPU). Off by default: the XLA path is
-    # the reference.
-    applier_use_pallas: bool = False
+    # faster than the XLA scan on TPU). Deprecated in favor of
+    # ``applier_kernel``; None defers to it, an explicit bool wins (the
+    # pre-kernel-selection API keeps working).
+    applier_use_pallas: Optional[bool] = None
+    # contract-kernel selection for the apply step: "auto" picks the
+    # Pallas VMEM-resident kernel on real TPU devices and the XLA scan
+    # everywhere else (falling back to XLA when the doc geometry cannot
+    # tile, i.e. docs-per-shard % 8 != 0); "pallas"/"xla" force a lane
+    # (a forced "pallas" raises on incompatible geometry instead of
+    # silently degrading).
+    applier_kernel: str = "auto"
+    # overlap-staged dispatch: stage wave N+1 on the host (pack +
+    # per-shard scatter + device_put) while wave N executes
+    # asynchronously on device. Off = fence each wave before staging the
+    # next (the serialized pre-overlap behavior, kept for A/B).
+    applier_overlap: bool = True
     # ---- client: summarizer heuristics (ref: summarizer.ts:232)
     summary_max_ops: int = 100           # ops since last ack → attempt
     # ---- DDS: merge-tree snapshot chunking (ref: snapshotV1.ts:87)
@@ -70,7 +83,10 @@ class Config:
                 # set-but-empty (export FLUID_TPU_X=) means "unset" in
                 # shell convention: keep the layered default
                 continue
-            typ = type(getattr(base, f.name))
+            cur = getattr(base, f.name)
+            # Optional fields default to None: the only such tunables are
+            # bool-typed (applier_use_pallas), so parse them as booleans
+            typ = bool if cur is None else type(cur)
             if typ is bool:
                 # bool("0") is True — parse the usual spellings instead
                 low = raw.strip().lower()

@@ -24,8 +24,9 @@ Mosaic lowering constraints found by bisection on this toolchain (and
 baked into the shapes here): bool and 3-D arrays crash the compiler when
 loop-carried, and jnp.cumsum / value-level dynamic_slice / argmax do not
 lower — hence int32 overflow, prop tables carried as P separate 2-D
-planes (statically unrolled), the Hillis-Steele lane scan, ref-level
-pl.ds reads, and masked-min first-True selection.
+planes (statically unrolled), the blocked segmented lane scan (see
+_cumsum_lanes), ref-level pl.ds reads, and masked-min first-True
+selection.
 """
 
 from __future__ import annotations
@@ -72,15 +73,62 @@ def _rowtake(col, a, j):
     return jnp.sum(jnp.where(col == j, a, 0), axis=1, keepdims=True)
 
 
-def _cumsum_lanes(x, col, S):
-    """Inclusive prefix sum along the lane axis: log2(S) Hillis-Steele
-    rounds of roll+masked-add (jnp.cumsum does not lower in Pallas TPU;
-    rolls are circular, so the col>=n mask kills wrapped lanes)."""
+#: segmented-scan block width: one vector register row of lanes. Strides
+#: below this stay inside a single register rotate on the VPU; strides at
+#: or above it cross register boundaries and pay a real shuffle.
+SCAN_BLOCK = 128
+
+
+def _cumsum_lanes(x, col, S, block=None):
+    """Inclusive prefix sum along the lane axis as a BLOCKED segmented
+    scan (SURVEY §5.7: per-block partial sums are a segmented
+    prefix-sum), replacing the flat Hillis-Steele lane scan.
+
+    Three phases over blocks of B = min(block, S) lanes:
+
+    1. within-block inclusive scan — log2(B) Hillis-Steele rounds whose
+       mask confines every roll to its own block (``lane >= n`` kills
+       both the circular wrap and cross-block bleed);
+    2. block partial sums (the §5.7 partial-lengths table) live at each
+       block's last lane; an inter-block Hillis-Steele at strides
+       B..S/2 turns them into an inclusive scan of block totals —
+       block-end lanes map to block-end lanes under multiples of B, so
+       non-end lanes only ever accumulate rolled zeros;
+    3. each block j>0 picks up block j-1's scanned total (roll by 1
+       lands it on the block's first lane) and broadcasts it across the
+       block with one more masked prefix pass.
+
+    Round count is 2·log2(B) + log2(S/B) + 1 vs the flat scan's
+    log2(S) — MORE rounds, but all except the log2(S/B) carry rounds
+    run at stride < B, i.e. inside one vector register row; the flat
+    scan's large-stride rolls (up to S/2 lanes) are the ones that cost
+    cross-register shuffles on real TPUs. Off-TPU (interpret mode) the
+    two are numerically identical; parity with jnp.cumsum is pinned by
+    tests/test_pallas_apply.py. jnp.cumsum itself does not lower in
+    Pallas TPU, hence the roll+mask formulation throughout."""
+    B = min(block or SCAN_BLOCK, S)
+    assert S % B == 0, (S, B)
+    lane = col % B  # col is an iota, so this is plain int arithmetic
     n = 1
-    while n < S:
-        x = x + jnp.where(col >= n, pltpu.roll(x, n, 1), 0)
+    while n < B:
+        x = x + jnp.where(lane >= n, pltpu.roll(x, n, 1), 0)
         n *= 2
-    return x
+    if B == S:
+        return x
+    # phase 2: scan the per-block totals (resident at block-end lanes)
+    tot = jnp.where(lane == B - 1, x, 0)
+    n = B
+    while n < S:
+        tot = tot + jnp.where(col >= n, pltpu.roll(tot, n, 1), 0)
+        n *= 2
+    # phase 3: block j's carry = scanned total through block j-1; the
+    # col >= B mask keeps block 0 carry-free (roll is circular)
+    carry = jnp.where((lane == 0) & (col >= B), pltpu.roll(tot, 1, 1), 0)
+    n = 1
+    while n < B:
+        carry = carry + jnp.where(lane >= n, pltpu.roll(carry, n, 1), 0)
+        n *= 2
+    return x + carry
 
 
 def _apply_one(carry, op_row, S):
@@ -298,8 +346,10 @@ def _contract_example():
     example=_contract_example,
     no_gather=True,
     no_scatter=True,
+    no_int16_arithmetic=True,
     single_jit=True,
-    notes="Pallas VMEM-resident apply (tile of R docs)",
+    notes="Pallas VMEM-resident apply (tile of R docs, blocked "
+          "segmented lane scan)",
 )
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_apply_ops_batch(state: DocState, ops: jax.Array,

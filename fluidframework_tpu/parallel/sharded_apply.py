@@ -14,6 +14,7 @@ compaction runs fused in the same dispatch when ``min_seq`` advances.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +44,20 @@ def shard_state(state: DocState, mesh: Mesh) -> DocState:
     return jax.tree.map(lambda x: jax.device_put(x, s), state)
 
 
-def make_sharded_step(mesh: Mesh, donate: bool = True):
+def donation_supported() -> bool:
+    """Whether donating the state buffer into the step is a win here.
+
+    On TPU (and GPU) the PJRT client honors input/output aliasing and the
+    dispatch stays asynchronous — donation halves the state's device
+    footprint for free. The CPU client instead runs donating computations
+    SYNCHRONOUSLY (the dispatch call blocks until the step completes) and
+    then ignores the aliasing request anyway — donation there buys
+    nothing and serializes the stage/execute overlap pipeline. Gate by
+    backend so host runs keep async dispatch."""
+    return jax.default_backend() != "cpu"
+
+
+def make_sharded_step(mesh: Mesh, donate: Optional[bool] = None):
     """Build the jitted sharded step:
 
     ``step(state, ops) -> (state', stats)`` where ``state`` holds [D, S]
@@ -72,6 +86,8 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
         out_specs=(dp, P()),
         check_vma=False,
     )
+    if donate is None:
+        donate = donation_supported()
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
@@ -81,7 +97,7 @@ def make_sharded_step(mesh: Mesh, donate: bool = True):
 _PACKED_STEP_CACHE: dict = {}
 
 
-def make_sharded_packed_step(mesh: Mesh, donate: bool = True,
+def make_sharded_packed_step(mesh: Mesh, donate: Optional[bool] = None,
                              use_pallas: bool = False,
                              pallas_interpret: bool = False,
                              trace_hook=None):
@@ -99,6 +115,8 @@ def make_sharded_packed_step(mesh: Mesh, donate: bool = True,
     ``trace_hook(kernel, shape)`` (optional) runs at TRACE time inside
     the jitted body — the service layer injects its recompile-telemetry
     counter through it (parallel must not import obs; layer DAG)."""
+    if donate is None:
+        donate = donation_supported()
     key = (mesh, donate, use_pallas, pallas_interpret)
     fn = _PACKED_STEP_CACHE.get(key)
     if fn is not None:
@@ -209,4 +227,42 @@ register_kernel_contract(
     no_int16_arithmetic=True,
     single_jit=True,
     notes="int16 packed-wave unpack + doc-sharded apply + fused zamboni",
+)
+
+
+def _packed_pallas_contract_build():
+    """The packed mesh step with the per-shard Pallas apply selected
+    (interpret mode so the contract checks run on any backend; the
+    traced program is what the contract is about and is identical to
+    the Mosaic-lowered one)."""
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("docs",))
+    step, _wide = make_sharded_packed_step(
+        mesh, donate=False, use_pallas=True, pallas_interpret=True)
+
+    def example():
+        D, S, K = 8, 16, 4
+        state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+        state = shard_state(state, mesh)
+        wave16 = jnp.zeros((D, K, OP_FIELDS), jnp.int16)
+        bases = jnp.zeros((D, 2), jnp.int32)
+        return (state, wave16, bases), {}
+
+    return step, example
+
+
+# contract: the mesh lane's Pallas selection keeps every invariant of the
+# XLA lane — the checker walks INTO the pallas_call jaxpr inside the
+# shard_map body, so the segmented-scan rewrite cannot smuggle a scatter,
+# an extra gather, or silent int16 promotion past the lint
+register_kernel_contract(
+    "parallel.sharded_step_packed_pallas",
+    build=_packed_pallas_contract_build,
+    no_scatter=True,
+    max_gathers=10,
+    no_int16_arithmetic=True,
+    single_jit=True,
+    notes="int16 packed wave + per-shard Pallas VMEM apply + fused "
+          "zamboni over the 'docs' mesh axis",
 )

@@ -75,6 +75,67 @@ MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 # per-instance closures would each re-trace/re-compile every shape bucket
 _DENSE_STEP_CACHE: dict = {}
 
+# process-wide small thread pool for per-shard staging jobs: the numpy
+# fancy-index scatter and device_put both release the GIL, so active
+# shards stage concurrently on multi-core hosts (shared across applier
+# instances — worker threads are lazy and cheap, lifecycles are not)
+_STAGE_POOL = None
+
+
+def _stage_executor():
+    global _STAGE_POOL
+    if _STAGE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _STAGE_POOL = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="applier-stage")
+    return _STAGE_POOL
+
+
+class _StagedWave:
+    """The output of the stage half of a dispatch: device-resident input
+    buffers plus what the execute half needs to run and account for the
+    wave. Holding one of these means the wave's ops have LEFT the staged
+    dict but have not yet been issued to the device."""
+
+    __slots__ = ("lane", "wide", "arrays", "n", "nbytes", "flip")
+
+    def __init__(self, lane: str, wide: bool, arrays: tuple, n: int,
+                 nbytes: int):
+        self.lane = lane        # "dense" | "mesh" (metrics label)
+        self.wide = wide        # int32 escape lane (range / force_wide)
+        self.arrays = arrays    # device arrays, step-call order
+        self.n = n              # op rows in the wave
+        self.nbytes = nbytes    # host bytes staged
+        self.flip = 0           # which staging-buffer set holds the wave
+
+
+def _resolve_kernel(kernel, use_pallas, cfg, tile_docs: int) -> bool:
+    """Resolve the applier's contract kernel to use_pallas.
+
+    Precedence: an explicit ``use_pallas`` bool (the pre-selection API)
+    wins, then config ``applier_use_pallas`` when set, then
+    ``kernel``/``applier_kernel``. ``auto`` selects Pallas only on real
+    TPU devices AND when the doc geometry tiles (R=8 docs per grid
+    instance); a forced ``pallas`` raises on bad geometry instead of
+    silently degrading, while ``auto`` falls back to the XLA scan."""
+    if use_pallas is None:
+        use_pallas = cfg.applier_use_pallas
+    if use_pallas is not None:
+        use, origin = bool(use_pallas), "applier_use_pallas"
+    else:
+        kernel = kernel if kernel is not None else cfg.applier_kernel
+        if kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"applier_kernel={kernel!r}: expected auto|pallas|xla")
+        if kernel == "auto":
+            return jax.default_backend() == "tpu" and tile_docs % 8 == 0
+        use, origin = kernel == "pallas", "applier_kernel=pallas"
+    if use and tile_docs % 8:
+        raise ValueError(
+            f"{origin} requires docs-per-shard % 8 == 0 (got {tile_docs})")
+    return use
+
 
 def _count_trace(kernel: str, shape: str) -> None:
     """Runs at TRACE time only (a Python side effect inside a jitted
@@ -124,8 +185,14 @@ def _dense_step_for(D: int, K: int, use_pallas: bool = False,
             state = apply_fn(state, wave)
             return compact_batch(state, wave_min_seq(wave)), {}
 
-        fn = (jax.jit(dense_step, donate_argnums=(0,)),
-              jax.jit(dense_step_wide, donate_argnums=(0,)))
+        from ..parallel.sharded_apply import donation_supported
+
+        # donation gated by backend: the CPU client runs donating
+        # computations synchronously, which would serialize the
+        # stage/execute overlap pipeline (see donation_supported)
+        don = (0,) if donation_supported() else ()
+        fn = (jax.jit(dense_step, donate_argnums=don),
+              jax.jit(dense_step_wide, donate_argnums=don))
         _DENSE_STEP_CACHE[(D, K, use_pallas, pallas_interpret)] = fn
     return fn
 
@@ -158,6 +225,40 @@ register_kernel_contract(
     no_int16_arithmetic=True,
     single_jit=True,
     notes="int16 packed-wave unpack + batched apply + fused zamboni",
+)
+
+
+def _contract_build_pallas():
+    """The same packed-wave applier with kernel=pallas selected
+    (interpret mode so the contract checks run on any backend — the
+    traced program is identical to the Mosaic-lowered one)."""
+    D, K = 8, 4
+    packed_fn, _wide_fn = _dense_step_for(D, K, use_pallas=True,
+                                          pallas_interpret=True)
+
+    def example():
+        S = 16
+        state = jax.vmap(lambda _: DocState.empty(S))(jnp.arange(D))
+        wave16 = jnp.zeros((D, K, OP_FIELDS), jnp.int16)
+        bases = jnp.zeros((D, 2), jnp.int32)
+        return (state, wave16, bases), {}
+
+    return packed_fn, example
+
+
+# contract: the default-on Pallas lane must honor the SAME wire-format
+# invariants as the XLA lane — the checker walks INTO the pallas_call
+# jaxpr, so a scatter or int16 promotion smuggled into the Mosaic body
+# fails identically; zamboni's once-per-wave repack owns the only gathers
+register_kernel_contract(
+    "service.dense_step_packed_pallas",
+    build=_contract_build_pallas,
+    no_scatter=True,
+    max_gathers=10,
+    no_int16_arithmetic=True,
+    single_jit=True,
+    notes="int16 packed wave through the Pallas VMEM apply lane "
+          "(applier.kernel=pallas selection of the dense step)",
 )
 
 
@@ -210,6 +311,8 @@ class TpuDocumentApplier:
         min_wave_ops: Optional[int] = None,
         use_pallas: Optional[bool] = None,
         pallas_interpret: bool = False,
+        kernel: Optional[str] = None,
+        overlap: Optional[bool] = None,
     ):
         from ..config import DEFAULT as _CFG
 
@@ -285,18 +388,41 @@ class TpuDocumentApplier:
         self.mesh_active_shards = 0
         self.mesh_staged_bytes = 0
         self.mesh_stage_seconds = 0.0
-        use_pallas = (use_pallas if use_pallas is not None
-                      else _CFG.applier_use_pallas)
+        # ---- overlap-staged dispatch (stage/execute split) ----
+        # Two rotating host staging buffer sets: wave N+1 scatters into
+        # one set while the other set's device_put (wave N) may still be
+        # copying — _rotate_stage_buffers fences a set's previous
+        # transfers before handing it out again, so async H2D and state
+        # donation stay sound even when the backend copies lazily.
+        self._overlap = (overlap if overlap is not None
+                         else _CFG.applier_overlap)
+        self._stage_pool: tuple = ({}, {})
+        self._stage_inflight: list = [None, None]
+        self._stage_flip = 0
+        # the last dispatched step's output: is_ready() is the
+        # non-blocking "device still executing" probe the overlap-ratio
+        # accounting keys on; _drain_device() fences it at seams
+        self._exec_marker = None
+        # host-stage vs device-execute split, BOTH lanes (the pre-overlap
+        # code only took t0 in mesh mode, so the dense lane reported zero
+        # staging cost and the kernel-plateau analysis had no split)
+        self.stage_seconds = 0.0
+        self.stage_overlap_seconds = 0.0
+        self.stage_bytes = 0
+        self.exec_seconds = 0.0
+        self.waves_staged = 0
+        self._registry = None
+        # contract-kernel selection: auto = Pallas on real TPU, XLA scan
+        # elsewhere; dense lane's tile is max_docs (= slots_per_shard of
+        # the 1-shard placement), mesh lane's is slots-per-shard
+        use_pallas = _resolve_kernel(kernel, use_pallas, _CFG,
+                                     self.placement.slots_per_shard)
+        self.kernel_lane = "pallas" if use_pallas else "xla"
         if mesh is not None:
             from ..parallel.sharded_apply import (
                 doc_sharding, make_sharded_packed_step, shard_state)
 
             self.state = shard_state(self.state, mesh)
-            sps = self.placement.slots_per_shard
-            if use_pallas and sps % 8:
-                raise ValueError(
-                    "applier_use_pallas requires slots-per-shard % 8 == 0 "
-                    f"(got {sps})")
             # the mesh twin of _dense_step_for: same int16 packed wave,
             # unpacked per shard inside shard_map, state donated, stats
             # psum'd — the dispatch path below is otherwise identical to
@@ -306,6 +432,7 @@ class TpuDocumentApplier:
                 pallas_interpret=pallas_interpret,
                 trace_hook=_count_trace)
             self._mesh_sharding = doc_sharding(mesh)
+            sps = self.placement.slots_per_shard
             # device → docs-shard map for pre-partitioned wave assembly:
             # P("docs") splits axis 0 into contiguous blocks in mesh
             # order, so the device whose block starts at shard*sps IS
@@ -321,14 +448,14 @@ class TpuDocumentApplier:
             # INACTIVE shards (no host alloc, no transfer)
             self._zero_shards: dict = {}
         else:
-            self._step = jax.jit(self._local_step, donate_argnums=(0,))
+            from ..parallel.sharded_apply import donation_supported
+
+            self._step = jax.jit(
+                self._local_step,
+                donate_argnums=(0,) if donation_supported() else ())
             # dense dispatch: ship the padded [D, K, F] wave packed to
             # int16 deltas (see _dense_step_for for the wire format and
             # why device-side scatter lost)
-            if use_pallas and max_docs % 8:
-                raise ValueError(
-                    "applier_use_pallas requires max_docs % 8 == 0 "
-                    f"(got {max_docs})")
             self._dense_step = _dense_step_for(
                 max_docs, self.K, use_pallas=use_pallas,
                 pallas_interpret=pallas_interpret)
@@ -702,17 +829,76 @@ class TpuDocumentApplier:
         return parts
 
     def _dispatch_wave(self, parts) -> int:
-        """Pack the wave host-side and dispatch it (ops/apply.py's
-        packed-wave section documents the int16-delta wire format).
+        """Stage then execute one wave — the serialized entry point.
+        The pipelining callers (_flush_sync, _worker_loop) go through the
+        same pair; overlap comes from the execute half being an async
+        dispatch, so the NEXT iteration's stage half runs on the host
+        while this wave executes on device."""
+        staged = self._stage_wave(parts)
+        if staged is None:
+            return 0
+        return self._execute_wave(staged)
+
+    # --------------------------------------------- stage / execute halves
+
+    def _metrics(self):
+        if self._registry is None:
+            from ..obs import get_registry
+
+            self._registry = get_registry()
+        return self._registry
+
+    def _rotate_stage_buffers(self) -> None:
+        """Flip to the other staging buffer set, fencing the EXECUTION
+        that last consumed it (``jax.device_put`` may alias the host
+        buffer rather than copy — readiness of the input array proves
+        nothing, only step completion makes the memory reusable). By
+        rotation the fenced wave is two dispatches old, so with the
+        pipeline one wave deep the block is a no-op — it only waits when
+        the device has fallen a full double-buffer behind."""
+        self._stage_flip ^= 1
+        pending = self._stage_inflight[self._stage_flip]
+        if pending is not None:
+            jax.block_until_ready(pending)
+            self._stage_inflight[self._stage_flip] = None
+
+    def _stage_buffer(self, shape: tuple, dtype) -> np.ndarray:
+        """A zeroed host staging buffer from the CURRENT rotation set
+        (callers run _rotate_stage_buffers once per wave first)."""
+        pool = self._stage_pool[self._stage_flip]
+        key = (shape, np.dtype(dtype).str)
+        buf = pool.get(key)
+        if buf is None:
+            buf = np.zeros(shape, dtype)
+            pool[key] = buf
+        else:
+            buf.fill(0)
+        return buf
+
+    def _drain_device(self) -> None:
+        """Fence the in-flight wave. Checkpoint/restore, escalation,
+        force_wide, and state queries must never act on a farm with a
+        wave still executing — strict wave order at every seam."""
+        if self._exec_marker is not None:
+            jax.block_until_ready(self._exec_marker)
+
+    def _stage_wave(self, parts) -> Optional[_StagedWave]:
+        """The HOST half of a dispatch: concat chunks → pack_wave_rows →
+        scatter into rotating staging buffers → device_put. No device
+        compute is issued; the returned wave holds resident buffers only
+        (ops/apply.py's packed-wave section documents the int16-delta
+        wire format).
 
         One vectorized fancy-index write places every occupied row; the
         flat rows build as ONE ``np.array`` over the concatenated tuple
         list (per-doc conversions were the dominant host cost at high doc
         counts). ``_take_wave_locked`` caps each doc at K ops, so a wave
         always fits. In mesh mode the scatter targets compact per-shard
-        buffers for ACTIVE shards only (_dispatch_wave_mesh) — never an
+        buffers for ACTIVE shards only (_stage_wave_mesh) — never an
         O(max_docs) dense host array."""
-        t0 = time.perf_counter() if self._mesh is not None else 0.0
+        if parts is None:
+            return None
+        t0 = time.perf_counter()
         all_chunks: list = []
         slots: list[int] = []
         lens: list[int] = []
@@ -723,7 +909,7 @@ class TpuDocumentApplier:
             slots.append(slot)
             lens.append(count)
         if not all_chunks:
-            return 0
+            return None
         K = self.K
         flat = (all_chunks[0] if len(all_chunks) == 1
                 else np.concatenate(all_chunks))
@@ -739,84 +925,166 @@ class TpuDocumentApplier:
         force_wide = (
             self.fault_plane is not None
             and self.fault_plane("applier.dispatch", ops=n) == "force_wide")
+        if force_wide:
+            # the forced int32 lane is a different program: drain the
+            # pipeline so the width flip never reorders around an
+            # in-flight packed wave
+            self._drain_device()
         fits16 = (not force_wide
                   and packed.min() >= -32768 and packed.max() <= 32767)
+        self._rotate_stage_buffers()
         if self._mesh is not None:
-            self._dispatch_wave_mesh(flat, packed if fits16 else None,
-                                     doc_idx, pos_idx, slots_a,
-                                     seq_base, text_base, t0)
+            staged = self._stage_wave_mesh(
+                flat, packed if fits16 else None, doc_idx, pos_idx,
+                slots_a, seq_base, text_base, n)
         elif fits16:
-            packed_fn, _ = self._dense_step
-            wave16 = np.zeros((self.max_docs, K, OP_FIELDS), np.int16)
+            wave16 = self._stage_buffer((self.max_docs, K, OP_FIELDS),
+                                        np.int16)
             wave16[doc_idx, pos_idx] = packed.astype(np.int16)
-            bases = np.zeros((self.max_docs, 2), np.int32)
+            bases = self._stage_buffer((self.max_docs, 2), np.int32)
             bases[slots_a, 0] = seq_base
             bases[slots_a, 1] = text_base
-            self.state, _ = packed_fn(
-                self.state, jnp.asarray(wave16), jnp.asarray(bases))
+            staged = _StagedWave(
+                "dense", False,
+                (jax.device_put(wave16), jax.device_put(bases)),
+                n, wave16.nbytes + bases.nbytes)
         else:
             # a field escaped int16 (giant doc, huge window): ship the
             # wave at full width — rare, pays a 2x transfer + one extra
             # compile the first time it happens
-            _, wide_fn = self._dense_step
-            wave = np.zeros((self.max_docs, K, OP_FIELDS), np.int32)
+            wave = self._stage_buffer((self.max_docs, K, OP_FIELDS),
+                                      np.int32)
             wave[doc_idx, pos_idx] = flat
-            self.state, _ = wide_fn(self.state, jnp.asarray(wave))
+            staged = _StagedWave("dense", True, (jax.device_put(wave),),
+                                 n, wave.nbytes)
+        staged.flip = self._stage_flip
+        dt = time.perf_counter() - t0
+        # overlap accounting: this stage half counts as HIDDEN time when
+        # a previously dispatched wave is still executing (is_ready is a
+        # non-blocking completion probe, so the measurement never
+        # perturbs the pipeline it measures)
+        overlapped = (self._exec_marker is not None
+                      and not self._exec_marker.is_ready())
+        self.waves_staged += 1
+        self.stage_seconds += dt
+        self.stage_bytes += staged.nbytes
+        if overlapped:
+            self.stage_overlap_seconds += dt
+        if self._mesh is not None:
+            self.mesh_stage_seconds += dt
+        reg = self._metrics()
+        reg.inc("applier.stage.seconds", dt, lane=staged.lane)
+        reg.inc("applier.stage.bytes", staged.nbytes, lane=staged.lane)
+        reg.set_gauge("applier.stage.overlap_ratio",
+                      self.stage_overlap_seconds / self.stage_seconds,
+                      lane=staged.lane)
+        if self.fault_plane is not None:
+            # chaos seam: wave N+1 staged (popped from the staging dict,
+            # device buffers resident) but NOT yet executed — a crash
+            # here must lose nothing: restore replays it from the log
+            self.fault_plane("applier.stage.staged", ops=n)
+        return staged
+
+    def _execute_wave(self, staged: _StagedWave) -> int:
+        """The DEVICE half: one jitted-step dispatch on already-resident
+        buffers. With overlap on the dispatch is asynchronous — the
+        caller's next stage half runs while the device executes; overlap
+        off blocks until the step completes (the serialized pre-overlap
+        behavior, kept for A/B)."""
+        t0 = time.perf_counter()
+        packed_fn, wide_fn = (self._sharded_step if staged.lane == "mesh"
+                              else self._dense_step)
+        fn = wide_fn if staged.wide else packed_fn
+        self.state, _ = fn(self.state, *staged.arrays)
+        self._exec_marker = self.state.count
+        # the wave's staging buffers may be reused (and on CPU, where
+        # device_put can alias host memory, even READ) only after this
+        # execution completes — record its marker against the buffer set
+        # the wave staged from, for _rotate_stage_buffers to fence on
+        self._stage_inflight[staged.flip] = self._exec_marker
+        if not self._overlap:
+            jax.block_until_ready(self._exec_marker)
+        dt = time.perf_counter() - t0
+        self.exec_seconds += dt
+        self._metrics().inc("applier.exec.seconds", dt, lane=staged.lane)
         self.dispatches += 1
         self._dispatches_since_check += 1
-        return n
+        if self.fault_plane is not None:
+            # chaos seam: the wave is IN FLIGHT on device and the next
+            # wave is not yet staged — the other overlap-window order
+            self.fault_plane("applier.stage.inflight", ops=staged.n)
+        return staged.n
 
-    def _dispatch_wave_mesh(self, flat, packed, doc_idx, pos_idx, slots_a,
-                            seq_base, text_base, t0) -> None:
-        """Mesh-lane ship: scatter the wave into per-ACTIVE-shard buffers
-        and hand each mesh device its own addressable shard, so host
-        staging cost and transferred bytes are O(active shards · K),
-        never O(max_docs), and the jitted step sees inputs already in
-        its layout — no host-side global materialization, no XLA
-        resharding. ``packed=None`` ships the int32 wide wave (int16
-        range escape / chaos force_wide)."""
+    def stage_overlap_ratio(self) -> float:
+        """staged-while-executing seconds / total stage seconds."""
+        return (self.stage_overlap_seconds / self.stage_seconds
+                if self.stage_seconds else 0.0)
+
+    def _stage_wave_mesh(self, flat, packed, doc_idx, pos_idx, slots_a,
+                         seq_base, text_base, n: int) -> _StagedWave:
+        """Mesh-lane stage: scatter the wave into per-ACTIVE-shard
+        buffers and hand each mesh device its own addressable shard, so
+        host staging cost and transferred bytes are O(active shards · K),
+        never O(max_docs), and the jitted step sees inputs already in its
+        layout — no host-side global materialization, no XLA resharding.
+
+        The wave's rows are sorted by shard ONCE (each shard's rows
+        become a contiguous slice — the pre-overlap per-shard boolean
+        masks rescanned the whole wave per shard, the linear host cost
+        MULTICHIP_r06 measured), then the per-shard scatter+transfer jobs
+        run on a small thread pool: the numpy fancy-index write and
+        device_put both release the GIL, so active shards stage
+        concurrently on multi-core hosts. ``packed=None`` ships the int32
+        wide wave (int16 range escape / chaos force_wide)."""
         sps = self.placement.slots_per_shard
         K = self.K
         row_shard, local_doc = self.placement.split_rows(doc_idx)
-        active = [int(s) for s in np.unique(row_shard)]
-        packed_fn, wide_fn = self._sharded_step
-        staged_bytes = 0
-        if packed is not None:
-            p16 = packed.astype(np.int16)
-            doc_shard, local_slot = self.placement.split_rows(slots_a)
-            shard_waves: dict[int, np.ndarray] = {}
-            shard_bases: dict[int, np.ndarray] = {}
-            for s in active:
-                w = np.zeros((sps, K, OP_FIELDS), np.int16)
-                m = row_shard == s
-                w[local_doc[m], pos_idx[m]] = p16[m]
-                b = np.zeros((sps, 2), np.int32)
-                dm = doc_shard == s
-                b[local_slot[dm], 0] = seq_base[dm]
-                b[local_slot[dm], 1] = text_base[dm]
-                shard_waves[s] = w
-                shard_bases[s] = b
-                staged_bytes += w.nbytes + b.nbytes
-            wave_dev = self._mesh_assemble(
-                shard_waves, (K, OP_FIELDS), np.int16)
-            bases_dev = self._mesh_assemble(shard_bases, (2,), np.int32)
-            self.mesh_stage_seconds += time.perf_counter() - t0
-            self.state, _ = packed_fn(self.state, wave_dev, bases_dev)
+        order = np.argsort(row_shard, kind="stable")
+        sorted_shard = row_shard[order]
+        active = np.unique(sorted_shard)
+        n_active = len(active)
+        lo = np.searchsorted(sorted_shard, active, side="left")
+        hi = np.searchsorted(sorted_shard, active, side="right")
+        ld, pi = local_doc[order], pos_idx[order]
+        wide = packed is None
+        dtype = np.int32 if wide else np.int16
+        rows = (flat if wide else packed.astype(np.int16))[order]
+        W = self._stage_buffer((n_active, sps, K, OP_FIELDS), dtype)
+        if wide:
+            B = dlo = dhi = ls = sb = tb = None
         else:
-            shard_waves = {}
-            for s in active:
-                w = np.zeros((sps, K, OP_FIELDS), np.int32)
-                m = row_shard == s
-                w[local_doc[m], pos_idx[m]] = flat[m]
-                shard_waves[s] = w
-                staged_bytes += w.nbytes
-            wave_dev = self._mesh_assemble(
-                shard_waves, (K, OP_FIELDS), np.int32)
-            self.mesh_stage_seconds += time.perf_counter() - t0
-            self.state, _ = wide_fn(self.state, wave_dev)
+            B = self._stage_buffer((n_active, sps, 2), np.int32)
+            doc_shard, local_slot = self.placement.split_rows(slots_a)
+            dorder = np.argsort(doc_shard, kind="stable")
+            sorted_doc_shard = doc_shard[dorder]
+            dlo = np.searchsorted(sorted_doc_shard, active, side="left")
+            dhi = np.searchsorted(sorted_doc_shard, active, side="right")
+            ls = local_slot[dorder]
+            sb, tb = seq_base[dorder], text_base[dorder]
+
+        def job(i: int):
+            a, b = lo[i], hi[i]
+            W[i][ld[a:b], pi[a:b]] = rows[a:b]
+            if B is not None:
+                da, db = dlo[i], dhi[i]
+                B[i][ls[da:db], 0] = sb[da:db]
+                B[i][ls[da:db], 1] = tb[da:db]
+
+        if n_active > 1:
+            list(_stage_executor().map(job, range(n_active)))
+        else:
+            job(0)
+        shard_waves = {int(s): W[i] for i, s in enumerate(active)}
+        arrays = (self._mesh_assemble(shard_waves, (K, OP_FIELDS), dtype),)
+        staged_bytes = n_active * sps * K * OP_FIELDS * W.itemsize
+        if not wide:
+            shard_bases = {int(s): B[i] for i, s in enumerate(active)}
+            arrays += (self._mesh_assemble(shard_bases, (2,), np.int32),)
+            staged_bytes += n_active * sps * 2 * 4
         self.mesh_waves += 1
-        self.mesh_active_shards += len(active)
+        self.mesh_active_shards += n_active
         self.mesh_staged_bytes += staged_bytes
+        return _StagedWave("mesh", wide, arrays, n, staged_bytes)
 
     def _mesh_assemble(self, shard_bufs: dict, tail: tuple,
                        dtype) -> jax.Array:
@@ -903,9 +1171,11 @@ class TpuDocumentApplier:
             for slot in pending:
                 if slot not in self._host_docs:
                     self._escalate(slot, None, None)
+            self._drain_device()
             self._check_overflow()
             return
         self._flush_sync()
+        self._drain_device()
         if self._dispatches_since_check:
             self._check_overflow()
 
@@ -933,6 +1203,7 @@ class TpuDocumentApplier:
             return
         if self._staged.get(slot):
             self.flush()
+        self._drain_device()
         if self._dispatches_since_check:
             self._check_overflow()
 
@@ -1037,6 +1308,9 @@ class TpuDocumentApplier:
         """Rebuild the doc on the scalar oracle from its authoritative op
         log and continue host-side (SURVEY §7(e) escape hatch)."""
         tenant_id, document_id = self._doc_keys[slot]
+        # strict wave order at the escalation seam: the doc leaves the
+        # device farm only after its last in-flight wave lands
+        self._drain_device()
         if self._replay_log is None:
             # degrading to an empty replica would silently lose the doc
             raise RuntimeError(

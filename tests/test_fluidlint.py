@@ -17,7 +17,10 @@ import sys
 import jax.numpy as jnp
 import numpy as np
 
-from fluidframework_tpu.utils.contracts import kernel_contract
+from fluidframework_tpu.utils.contracts import (
+    kernel_contract,
+    register_kernel_contract,
+)
 from tools.fluidlint import (
     hygiene,
     jaxpr_check,
@@ -170,6 +173,46 @@ def test_packed_sharded_step_contract_holds():
     vs = [v for v in jaxpr_check.check_kernels(registry=reg, required=())
           if "sharded_step_packed" in str(v)]
     assert vs == [], [str(v) for v in vs]
+
+
+def test_pallas_packed_contracts_hold():
+    """The default-on Pallas lane (applier.kernel=pallas): both the
+    dense and mesh selections must satisfy every declared invariant —
+    the checker walks INTO the pallas_call jaxpr — and must be pinned in
+    REQUIRED_KERNELS so a future deregistration can't slip through."""
+    names = ("service.dense_step_packed_pallas",
+             "parallel.sharded_step_packed_pallas")
+    for name in names:
+        assert name in jaxpr_check.REQUIRED_KERNELS, name
+    reg = jaxpr_check.load_registry()
+    sub = {n: reg[n] for n in names}
+    vs = jaxpr_check.check_kernels(registry=sub, required=())
+    assert vs == [], [str(v) for v in vs]
+
+
+def test_pallas_contract_regression_fails_lint():
+    """A contract REGRESSION in the Pallas lane must fail the lint, not
+    pass silently: wrap the real registered kernel with int16 arithmetic
+    smuggled in ahead of the explicit widen and assert the checker flags
+    it under the same declared invariants."""
+    reg = jaxpr_check.load_registry()
+    good = reg["service.dense_step_packed_pallas"]
+
+    def regressed_build():
+        fn, example = good.build()
+
+        def regressed(state, wave16, bases):
+            return fn(state, wave16 * jnp.int16(2), bases)
+
+        return regressed, example
+
+    sub: dict = {}
+    register_kernel_contract(
+        "fixture.pallas_regressed", build=regressed_build,
+        no_int16_arithmetic=True, registry=sub)
+    vs = jaxpr_check.check_kernels(registry=sub, required=())
+    assert len(vs) == 1 and "int16" in vs[0].message, \
+        [str(v) for v in vs]
 
 
 # ------------------------------------------------------------------ wire
@@ -335,6 +378,17 @@ def test_placement_family_lock_caught(tmp_path):
     assert "placement.migration.committed" in vs[0].message
 
 
+def test_applier_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('applier.stage.secs')\n")  # typo'd member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "applier.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "applier.stage.seconds" in vs[0].message
+
+
 def test_boot_family_members_pass(tmp_path):
     path = _metrics_file(
         tmp_path,
@@ -342,7 +396,8 @@ def test_boot_family_members_pass(tmp_path):
         "    c.inc('boot.snapshot.used')\n"
         "    c.inc('boot.backfill.bounded')\n"
         "    c.inc('storage.snapshot.served')\n"
-        "    c.inc('placement.epoch.bumps')\n")
+        "    c.inc('placement.epoch.bumps')\n"
+        "    c.inc('applier.stage.overlap_ratio')\n")
     assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
 
 

@@ -200,6 +200,7 @@ def test_config_bool_env_parses_spellings(monkeypatch):
 
 def test_config_empty_env_value_keeps_default(monkeypatch):
     monkeypatch.setenv("FLUID_TPU_APPLIER_USE_PALLAS", "")
-    assert Config.from_env().applier_use_pallas is False
+    # the default is None (defer to applier_kernel); empty env keeps it
+    assert Config.from_env().applier_use_pallas is None
     monkeypatch.setenv("FLUID_TPU_CLIENT_TIMEOUT_S", "")
     assert Config.from_env().client_timeout_s == Config().client_timeout_s

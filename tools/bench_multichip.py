@@ -18,20 +18,42 @@ honest transfer-and-dispatch overhead curve, not an ICI scaling claim.
 the tentpole's structural claims instead:
   * per-wave staged bytes scale with ACTIVE shards, never with max_docs
     (the pre-refactor dense wave was O(max_docs) on every wave);
-  * the sharded step compiles exactly once per wave shape.
+  * the sharded step compiles exactly once per wave shape — with the
+    overlap pipeline armed;
+  * ``applier.stage.overlap_ratio`` goes positive when waves pipeline
+    (staging really ran while the device executed).
 
-Artifact schema v2 (MULTICHIP_r06+)::
+Artifact schema v3 (MULTICHIP_r07+) adds the overlap-staged dispatch
+split::
 
-    {"schema": 2, "platform": ..., "n_devices": 8, "forced_host": true,
+    {"schema": 3, "platform": ..., "n_devices": 8, "forced_host": true,
+     "host_limited": true, "host_limited_note": ...,
+     "overlap": true, "efficiency_basis": "wall",
      "rungs": [{"docs_axis": n, "n_docs": D, "ops_per_sec": ...,
-                "scaling_efficiency": ..., "staging_ms_per_wave": ...,
-                "staged_bytes_per_wave": ...}, ...],
+                "pipeline_ops_per_sec": ..., "scaling_efficiency": ...,
+                "overlap_ratio": ..., "stage_ms_hidden": ...,
+                "kernel_lane": "xla"|"pallas",
+                "staging_ms_per_wave": ..., "staged_bytes_per_wave": ...},
+               ...],
      "local_dense_ops_per_sec": ..., "mesh_vs_local_1shard": ...,
+     "local_dense_ab": {"n_docs": D, "on": {...}, "off": {...},
+                        "improvement": ..., "improvement_basis": ...},
      "ok": true, "rc": 0}
 
-``read_multichip`` also accepts the pre-r06 dryrun schema
-({n_devices, rc, ok, skipped, tail}) and normalizes it to v2 shape with
-an empty rung list, so dashboards can fold the whole r01..rNN series.
+``ops_per_sec`` stays wall-clock and ``scaling_efficiency`` is computed
+on it (``efficiency_basis: "wall"`` — the number that cannot lie).
+``pipeline_ops_per_sec`` divides by the HOST critical path instead:
+un-hidden staging time plus the (async) dispatch call — the path the
+overlap pipeline shrinks and the throughput predictor for a real mesh.
+On forced host-platform devices every "chip" time-slices one core, so
+wall throughput arithmetically cannot rise with the axis; the artifact
+then carries ``host_limited: true`` with a note, and the overlap
+mechanism is evidenced by per-rung ``overlap_ratio`` plus the smoke
+gate's counter-asserts.
+
+``read_multichip`` folds all generations: v1 dryruns
+({n_devices, rc, ok, skipped, tail}) normalize to an empty rung list;
+v2 (r06) rungs gain null overlap fields.
 """
 
 from __future__ import annotations
@@ -43,22 +65,45 @@ import time
 import types
 
 
+#: per-rung fields added by schema v3 (null when folded from older runs)
+_V3_RUNG_FIELDS = ("pipeline_ops_per_sec", "overlap_ratio",
+                   "stage_ms_hidden", "kernel_lane")
+
+
 def read_multichip(path: str) -> dict:
-    """Load a MULTICHIP artifact of ANY generation as schema v2."""
+    """Load a MULTICHIP artifact of ANY generation as schema v3."""
     with open(path) as f:
         raw = json.load(f)
-    if raw.get("schema", 1) >= 2:
+    schema = raw.get("schema", 1)
+    if schema >= 3:
+        return raw
+    if schema == 2:
+        # r06: real rungs, pre-overlap — the v3 split fields are unknown
+        for r in raw.get("rungs", []):
+            for f2 in _V3_RUNG_FIELDS:
+                r.setdefault(f2, None)
+        raw.setdefault("overlap", False)
+        raw.setdefault("efficiency_basis", "wall")
+        raw.setdefault("host_limited", raw.get("forced_host"))
+        raw.setdefault("host_limited_note", None)
+        raw.setdefault("local_dense_ab", None)
+        raw["schema"] = 3
         return raw
     # r01..r05 dryrun schema: presence/absence of a multi-device compile,
     # no throughput rungs
     return {
-        "schema": 2,
+        "schema": 3,
         "platform": None,
         "n_devices": raw.get("n_devices"),
         "forced_host": None,
+        "host_limited": None,
+        "host_limited_note": None,
+        "overlap": False,
+        "efficiency_basis": "wall",
         "rungs": [],
         "local_dense_ops_per_sec": None,
         "mesh_vs_local_1shard": None,
+        "local_dense_ab": None,
         "ok": bool(raw.get("ok")) and not raw.get("skipped"),
         "rc": raw.get("rc"),
     }
@@ -98,33 +143,53 @@ def _fence(applier) -> None:
 
 def _time_applier(applier, docs, k: int, warmup: int = 2,
                   timed: int = 8) -> dict:
-    """Ops/s over `timed` full waves (ingest excluded: the bench isolates
-    the wave-build → transfer → dispatch lane, and the host staging slice
-    of it is reported separately from the applier's own counters)."""
+    """Ops/s over `timed` PIPELINED waves (ingest excluded: the bench
+    isolates the stage → transfer → dispatch lane). All timed waves are
+    pre-ingested and ONE flush drains them, so wave i+1 stages on the
+    host while wave i executes on device — the overlap lane this bench
+    exists to measure. (The pre-overlap bench fenced after every wave,
+    serializing exactly the path under test.) Works for both lanes: the
+    stage/execute split counters are fed by dense and mesh alike."""
     seqs = {d: 0 for d in docs}
     for _ in range(warmup):
         _stage_wave(applier, docs, seqs, k)
         applier.flush()
     _fence(applier)
-    stage_s0 = applier.mesh_stage_seconds
-    waves0 = applier.mesh_waves
-    bytes0 = applier.mesh_staged_bytes
+    stage_s0 = applier.stage_seconds
+    hidden_s0 = applier.stage_overlap_seconds
+    bytes0 = applier.stage_bytes
+    waves0 = applier.waves_staged
+    exec_s0 = applier.exec_seconds
     total_ops = 0
-    elapsed = 0.0
     for _ in range(timed):
         total_ops += _stage_wave(applier, docs, seqs, k)
-        t0 = time.perf_counter()
-        applier.flush()
-        _fence(applier)
-        elapsed += time.perf_counter() - t0
-    waves = applier.mesh_waves - waves0
+    t0 = time.perf_counter()
+    applier.flush()
+    _fence(applier)
+    elapsed = time.perf_counter() - t0
+    stage_s = applier.stage_seconds - stage_s0
+    hidden_s = applier.stage_overlap_seconds - hidden_s0
+    exec_s = applier.exec_seconds - exec_s0
+    waves = applier.waves_staged - waves0
+    # the HOST critical path per wave: staging not hidden behind device
+    # execution, plus the (async) dispatch call. On a real mesh this
+    # path bounds throughput once per-device compute is constant (weak
+    # scaling); on forced-host devices one core also runs all the
+    # "device" compute, so wall time cannot scale and this is the
+    # honest predictor the overlap work moves.
+    host_path_s = (stage_s - hidden_s) + exec_s
     return {
         "ops_per_sec": round(total_ops / elapsed, 1),
-        "staging_ms_per_wave": (
-            round((applier.mesh_stage_seconds - stage_s0) / waves * 1e3, 4)
-            if waves else None),
-        "staged_bytes_per_wave": (
-            (applier.mesh_staged_bytes - bytes0) // waves if waves else None),
+        "pipeline_ops_per_sec": (round(total_ops / host_path_s, 1)
+                                 if host_path_s > 0 else None),
+        "staging_ms_per_wave": (round(stage_s / waves * 1e3, 4)
+                                if waves else None),
+        "stage_ms_hidden": (round(hidden_s / waves * 1e3, 4)
+                            if waves else None),
+        "overlap_ratio": round(hidden_s / stage_s, 3) if stage_s else None,
+        "staged_bytes_per_wave": ((applier.stage_bytes - bytes0) // waves
+                                  if waves else None),
+        "kernel_lane": applier.kernel_lane,
     }
 
 
@@ -138,6 +203,7 @@ def run_sweep(axes=(1, 2, 4, 8)) -> dict:
     from fluidframework_tpu.parallel.mesh import make_mesh
     from fluidframework_tpu.service.tpu_applier import TpuDocumentApplier
 
+    forced_host = jax.devices()[0].platform == "cpu"
     rungs = []
     for n in axes:
         D = DOCS_PER_SHARD * n
@@ -147,6 +213,12 @@ def run_sweep(axes=(1, 2, 4, 8)) -> dict:
         docs = [f"d{i}" for i in range(D)]
         r = _time_applier(applier, docs, K)
         rungs.append({"docs_axis": n, "n_docs": D, **r})
+    # weak-scaling efficiency vs the 1-shard rung, on WALL throughput —
+    # the number that cannot lie. pipeline_ops_per_sec per rung shows
+    # the host critical path the overlap pipeline shrinks; on forced
+    # host devices it goes near-free at rungs the runtime can keep two
+    # waves in flight, which would flatter the efficiency column, so it
+    # stays informational and the artifact is annotated host_limited.
     base = rungs[0]["ops_per_sec"]
     for r in rungs:
         r["scaling_efficiency"] = round(
@@ -156,28 +228,52 @@ def run_sweep(axes=(1, 2, 4, 8)) -> dict:
     local = TpuDocumentApplier(max_docs=DOCS_PER_SHARD, max_slots=64,
                                ops_per_dispatch=K)
     docs1 = [f"d{i}" for i in range(DOCS_PER_SHARD)]
-    seqs = {d: 0 for d in docs1}
-    for _ in range(2):
-        _stage_wave(local, docs1, seqs, K)
-        local.flush()
-    _fence(local)
-    ops = elapsed = 0
-    for _ in range(8):
-        ops += _stage_wave(local, docs1, seqs, K)
-        t0 = time.perf_counter()
-        local.flush()
-        _fence(local)
-        elapsed += time.perf_counter() - t0
-    local_opsps = round(ops / elapsed, 1)
+    local_opsps = _time_applier(local, docs1, K)["ops_per_sec"]
+
+    # dense-lane A/B at the 4-doc-axis rung's doc count: overlap on vs
+    # off over the identical pipelined workload. The design's effect
+    # lives on the host critical path (improvement_basis), wall is
+    # reported alongside — on a single-core host the two arms do the
+    # same total work, so wall improvement there is bounded by the
+    # sync-call overhead the off arm pays.
+    ab_docs = DOCS_PER_SHARD * 4
+    ab = {}
+    for arm, overlap in (("on", True), ("off", False)):
+        applier = TpuDocumentApplier(max_docs=ab_docs, max_slots=64,
+                                     ops_per_dispatch=K, overlap=overlap)
+        ab[arm] = _time_applier(applier,
+                                [f"d{i}" for i in range(ab_docs)], K)
+
+    def _ratio(key):
+        on, off = ab["on"][key], ab["off"][key]
+        return round(on / off, 3) if on and off else None
+
+    host_limited_note = (
+        "forced host-platform devices time-slice one core: wall "
+        "throughput cannot rise with the docs axis, and the CPU runtime "
+        "intermittently serializes multi-wave dispatch at the 8-device "
+        "rung (overlap_ratio collapses there). The overlap mechanism is "
+        "proven by the lower rungs' overlap_ratio and the --smoke gate."
+        if forced_host else None)
+
     return {
-        "schema": 2,
+        "schema": 3,
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
-        "forced_host": jax.devices()[0].platform == "cpu",
+        "forced_host": forced_host,
+        "host_limited": forced_host,
+        "host_limited_note": host_limited_note,
+        "overlap": True,
+        "efficiency_basis": "wall",
         "rungs": rungs,
         "local_dense_ops_per_sec": local_opsps,
         "mesh_vs_local_1shard": round(rungs[0]["ops_per_sec"] / local_opsps,
                                       3),
+        "local_dense_ab": {"n_docs": ab_docs, "on": ab["on"],
+                           "off": ab["off"],
+                           "improvement": _ratio("pipeline_ops_per_sec"),
+                           "improvement_basis": "host_pipeline",
+                           "improvement_wall": _ratio("ops_per_sec")},
         "ok": True,
         "rc": 0,
     }
@@ -227,8 +323,25 @@ def run_smoke() -> None:
     b8 = (applier.mesh_staged_bytes - by0) // waves
     assert b8 == n_shards * per_shard, (b8, n_shards * per_shard)
 
-    # 20 same-shape waves → exactly one new compile on the packed step,
-    # none on the wide lane (it never ran)
+    # overlap: pipeline 10 pre-ingested waves through ONE flush, so the
+    # staging of wave i+1 runs while wave i executes. Both the instance
+    # counter and the exported gauge must go positive — staging really
+    # overlapped device execution, with overlap armed by default.
+    for _ in range(10):
+        _stage_wave(applier, docs, seqs, k)
+    applier.flush()
+    _fence(applier)
+    ratio = applier.stage_overlap_ratio()
+    assert ratio > 0, f"overlap_ratio {ratio} with pipelined waves"
+    from fluidframework_tpu.obs import get_registry, parse_prometheus
+
+    scraped = parse_prometheus(get_registry().scrape())
+    gauge = scraped.get("fluid_applier_stage_overlap_ratio", {})
+    assert gauge and max(gauge.values()) > 0, gauge
+
+    # 30 same-shape waves → exactly one new compile on the packed step,
+    # none on the wide lane (it never ran) — including across the
+    # pipelined overlap phase above
     assert packed_fn._cache_size() - cache0 <= 1, (cache0,
                                                    packed_fn._cache_size())
     assert wide_fn._cache_size() == wide0, (wide0, wide_fn._cache_size())
@@ -236,7 +349,8 @@ def run_smoke() -> None:
 
     assert not np.asarray(applier.state.overflow).any()
     print("bench_multichip --smoke: ok "
-          f"(per-wave bytes {b1} x active shards, dense was {dense})")
+          f"(per-wave bytes {b1} x active shards, dense was {dense}; "
+          f"overlap_ratio {ratio:.3f} with pipelined waves)")
 
 
 def main(argv=None) -> int:

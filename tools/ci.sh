@@ -30,15 +30,21 @@ python -m tools.net_smoke
 
 echo "--- multichip mesh smoke (8 forced host devices)"
 # counter-asserts the mesh lane's structural claims: per-wave staged
-# bytes scale with ACTIVE shards (never O(max_docs)), and the sharded
-# step compiles exactly once per wave shape
+# bytes scale with ACTIVE shards (never O(max_docs)), the sharded step
+# compiles exactly once per wave shape, and pipelined waves drive
+# applier.stage.overlap_ratio positive (the stage/execute overlap
+# really overlapped)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m tools.bench_multichip --smoke
 
 if [ "$run_soak" = 1 ]; then
-    echo "--- chaos soak (fixed seed, quick)"
-    python -m fluidframework_tpu.chaos.soak --seed 0 --quick
-    echo "soak: ok"
+    # three seeds so the overlap-window crash seams (wave N in flight /
+    # wave N+1 staged, both orders) land at different pipeline phases
+    for seed in 0 7 42; do
+        echo "--- chaos soak (seed $seed, quick)"
+        python -m fluidframework_tpu.chaos.soak --seed "$seed" --quick
+        echo "soak seed $seed: ok"
+    done
     echo "--- chaos soak, 2-shard mesh applier (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.soak --seed 0 --quick --phases a \
         --mesh-shards 2

@@ -17,7 +17,7 @@ inside a nested program. Checks:
   same-shape inputs and the pjit compilation-cache size must not grow
   on the second call.
 
-The five hot-path kernels named in ``REQUIRED_KERNELS`` must stay
+The hot-path kernels named in ``REQUIRED_KERNELS`` must stay
 registered — removing a ``@kernel_contract`` registration is itself a
 violation, so coverage cannot silently decay.
 """
@@ -48,7 +48,9 @@ REQUIRED_KERNELS = (
     "ops.pallas_apply_ops_batch",
     "parallel.sharded_step",
     "parallel.sharded_step_packed",
+    "parallel.sharded_step_packed_pallas",
     "service.dense_step_packed",
+    "service.dense_step_packed_pallas",
 )
 
 #: Primitives that do arithmetic (an int16 operand here = silent

@@ -72,6 +72,15 @@ LOCKED_FAMILIES = {
     # the placement control plane: the net-smoke migration gate, the
     # admin CLI, and the chaos migration campaign key on these exact
     # names (service/placement_plane.py)
+    # the device-dispatch pipeline: MULTICHIP's smoke gate counter-
+    # asserts overlap_ratio, profile_applier prints the stage/execute
+    # split, and the r7+ plateau analysis keys on these exact names
+    # (service/tpu_applier.py)
+    "applier.": frozenset({"applier.kernel.recompiled",
+                           "applier.stage.seconds",
+                           "applier.stage.bytes",
+                           "applier.stage.overlap_ratio",
+                           "applier.exec.seconds"}),
     "placement.": frozenset({"placement.epoch.bumps",
                              "placement.epoch.stale_nacks",
                              "placement.cache.hits",

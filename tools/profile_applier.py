@@ -25,6 +25,18 @@ Pallas-specific excess is dispatch-cost sensitivity (its per-step win
 over the scan is small, so tunnel jitter moves it more). The honest
 bound for regressions is this profile's ``step`` row (device compute
 with the wave resident), not the e2e artifact number.
+
+SUPERSEDED for attribution (overlap-staged dispatch): the r4→r5 note
+above had to reconstruct the host/device split after the fact because
+the production path timed only whole dispatches. The applier now
+accounts its own halves per lane — ``applier.stage.seconds`` (host wave
+assembly + transfer, with the hidden-behind-execute fraction) vs
+``applier.exec.seconds`` (the step dispatch) — so a throughput swing in
+a bench artifact is attributable directly from its counters: a stage
+swing is host/link weather, an exec swing is device weather or a kernel
+change. This profile prints that split below (``stage/execute split``)
+for the dense lane and, when the rig has multiple devices, the mesh
+lane; the manual pack/h2d/step rows remain the finer microscope.
 """
 
 from __future__ import annotations
@@ -36,6 +48,13 @@ import time
 sys.path.insert(0, "/root/repo")
 
 import numpy as np  # noqa: E402
+
+
+def _reset_split(applier) -> None:
+    applier.stage_seconds = applier.stage_overlap_seconds = 0.0
+    applier.exec_seconds = 0.0
+    applier.stage_bytes = 0
+    applier.waves_staged = 0
 
 
 def main() -> None:
@@ -72,10 +91,13 @@ def main() -> None:
                                   msn=seq0 + i - 1)
             app._push_chunk(d, rows)
 
-    # warm: compile both lanes
+    # warm: compile both lanes, then zero the split counters so the
+    # stage/execute rows below report steady-state waves, not the
+    # compile wave
     stage_full_wave(2)
     app._flush_sync()
     app._sync(0)
+    _reset_split(app)
 
     n_ops = D * K
 
@@ -143,6 +165,51 @@ def main() -> None:
     print(f"e2e  : {e2e*1e3:8.2f} ms  ({n_ops/e2e:10.0f} ops/s)")
     print(f"ceiling at this link = bw/bytes_per_op = "
           f"{bw/bpo:,.0f} ops/s")
+
+    # ---- stage/execute split: the applier's own per-lane accounting ----
+    # (the production path's first-class attribution — see docstring)
+    def split_row(lane: str, a) -> None:
+        waves = a.waves_staged
+        if not waves:
+            return
+        stage_ms = a.stage_seconds / waves * 1e3
+        exec_ms = a.exec_seconds / waves * 1e3
+        print(f"  {lane:5s}: stage {stage_ms:7.2f} ms/wave "
+              f"({a.stage_overlap_ratio()*100:5.1f}% hidden behind "
+              f"execute), exec-call {exec_ms:7.2f} ms/wave, "
+              f"kernel={a.kernel_lane}")
+
+    print("stage/execute split:")
+    split_row("dense", app)
+    if len(jax.devices()) > 1:
+        from fluidframework_tpu.parallel.mesh import make_mesh
+
+        n_sh = len(jax.devices())
+        mesh_app = TpuDocumentApplier(
+            max_docs=D, ops_per_dispatch=K, async_dispatch=False,
+            mesh=make_mesh(n_sh, seg_shards=1))
+        for d in range(D):
+            mesh_app.slot_of("t", f"doc{d}")
+        warmed = False
+        for t in range(T):
+            for d in range(D):
+                rows = np.zeros((K, OP_FIELDS), np.int32)
+                seq0 = 2 + t * K
+                for i in range(K):
+                    rows[i] = make_op(OP_INSERT, pos=0, seq=seq0 + i,
+                                      ref_seq=seq0 + i - 1, client=0,
+                                      text_len=1, text_start=seq0 + i,
+                                      msn=seq0 + i - 1)
+                mesh_app._push_chunk(d, rows)
+            if not warmed:
+                # first wave compiles; keep it out of the split rows
+                mesh_app._flush_sync()
+                jax.block_until_ready(mesh_app.state.length)
+                _reset_split(mesh_app)
+                warmed = True
+        mesh_app._flush_sync()
+        jax.block_until_ready(mesh_app.state.length)
+        split_row("mesh", mesh_app)
 
     # ---- recompiles: which kernels traced, how many times ----
     # a kernel-number swing between runs (the r4→r5 note above) is only
