@@ -1029,6 +1029,253 @@ def bench_join_storm() -> dict:
             fe.kill()
 
 
+def bench_net_read_storm() -> dict:
+    """Read-scale fan-out through a 2-level relay tree: writer ack and
+    core-tier egress vs read-only subscriber count.
+
+    Topology: core ← gw1 (``--core-port``, Python backbone) ← gw2
+    (``--upstream-gateway``), every subscriber parked on gw2, ONE writer
+    attached directly to the core. Subscribers are raw binary sockets
+    (``readonly=1`` connect, no Loader, no join/quorum) living in THIS
+    process behind a selectors drain — the host fd budget, not client
+    CPU, bounds the swarm, so the 10k target scales to the host and the
+    row carries ``host_limited`` when capped. Three probe windows
+    (0 readers → n/10 → n) price the claim three ways:
+
+    - **writer ack p99** at full fan-out vs the zero-reader baseline:
+      the relay tree must keep reader cost off the write path (asserted
+      within 10% unless host_limited — on a 1-CPU host every tier
+      time-slices the writer's core);
+    - **core-tier bytes/op**: gw1's ``fanout.upstream.bytes`` delta per
+      acked op, window-scoped so connect-burst replies don't pollute it
+      — asserted ~flat across the 10× subscriber growth (the
+      once-per-doc-per-link subscription is what makes it flat);
+    - **zero re-encodes above the core**: ``fanout.relay.encodes`` == 0
+      at BOTH gateway levels, always asserted — every hop splices
+      cached backbone bytes, never re-serializes.
+
+    Delivery is proven at the edge, not inferred: every subscriber
+    socket must grow past its pre-window watermark before a window's
+    counters are read (which also quiesces in-flight fan-out so the
+    byte deltas are complete).
+    """
+    import os
+    import resource
+    import selectors
+    import socket as _socket
+    import time as _time
+
+    from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader.container import Loader
+
+    target = 10_000
+    cpus = os.cpu_count() or 1
+    soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    # fd budget: n reader sockets here + n accepted in gw2 (its own
+    # limit), minus headroom for the writer/admin/spawn plumbing
+    n = min(target, max(256, soft - 512), target if cpus >= 4 else 2_000)
+    host_limited = (cpus < 4) or (n < target)
+    doc = "rstorm0"
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3)
+
+    def gw_counters(port: int) -> dict:
+        # same wire shape as _query_counters, different door: the
+        # gateway answers THIS tier's fanout.* counters locally instead
+        # of relaying to the core
+        with _socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            body = json.dumps({"t": "gateway_counters", "rid": 1}).encode()
+            s.sendall(len(body).to_bytes(4, "big") + body)
+
+            def read_exactly(k):
+                buf = b""
+                while len(buf) < k:
+                    chunk = s.recv(k - len(buf))
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    buf += chunk
+                return buf
+
+            while True:
+                m = int.from_bytes(read_exactly(4), "big")
+                frame = json.loads(read_exactly(m).decode())
+                if frame.get("rid") == 1:
+                    return frame.get("counters", {})
+
+    sel = selectors.DefaultSelector()
+    socks: list = []
+    rx: dict = {}
+    core = gw1 = gw2 = writer = None
+
+    def pump(cond, deadline_s: float) -> bool:
+        """Drain subscriber sockets until cond() or deadline."""
+        end = _time.monotonic() + deadline_s
+        while not cond():
+            if _time.monotonic() >= end:
+                return False
+            for key, _ in sel.select(0.2):
+                s = key.fileobj
+                try:
+                    b = s.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    b = b""
+                if b:
+                    rx[s] += len(b)
+                else:
+                    sel.unregister(s)  # EOF: rx stops growing, the
+                    # delivery watermark check names the window
+        return True
+
+    def add_readers(count: int, gw2_port: int) -> None:
+        base = len(socks)
+        for i in range(count):
+            s = _socket.create_connection(("127.0.0.1", gw2_port),
+                                          timeout=30)
+            s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            body = json.dumps({"t": "connect", "tenant": "bench",
+                               "doc": doc, "bin": 1, "readonly": 1,
+                               "rid": base + i}).encode()
+            s.sendall(len(body).to_bytes(4, "big") + body)
+            s.setblocking(False)
+            rx[s] = 0
+            sel.register(s, selectors.EVENT_READ)
+            socks.append(s)
+            # self-pacing: keep the un-replied connect burst under the
+            # accept backlog by waiting for handshakes to catch up
+            if len(socks) % 64 == 0:
+                want = len(socks) - 64
+                assert pump(
+                    lambda: sum(1 for t in socks if rx[t] > 0) >= want,
+                    120.0), "reader handshakes stalled mid-burst"
+        assert pump(lambda: all(rx[t] > 0 for t in socks), 120.0), \
+            "reader handshakes stalled"
+
+    def probe(k: int) -> list:
+        lats = []
+        for i in range(k):
+            t0 = _time.perf_counter()
+            sstr.insert_text(0, "x")
+            deadline = _time.monotonic() + 30.0
+            while (writer.runtime.pending.count
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.0005)
+            assert writer.runtime.pending.count == 0, \
+                f"read-storm probe op {i} never acked"
+            lats.append((_time.perf_counter() - t0) * 1e3)
+        return lats
+
+    def window(k: int) -> list:
+        marks = {s: rx[s] for s in socks}
+        lats = probe(k)
+        # edge delivery proof + fan-out quiesce before counters are read
+        assert pump(lambda: all(rx[s] > marks[s] for s in socks), 60.0), \
+            "a subscriber saw no broadcast bytes this window"
+        return lats
+
+    try:
+        core, core_port = _spawn_listening(
+            "fluidframework_tpu.service.front_end", "--port", "0")
+        gw1, gw1_port = _spawn_listening(
+            "fluidframework_tpu.service.gateway",
+            "--core-port", str(core_port), "--python")
+        gw2, gw2_port = _spawn_listening(
+            "fluidframework_tpu.service.gateway",
+            "--upstream-gateway", f"127.0.0.1:{gw1_port}")
+
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", core_port)).resolve("bench", doc)
+        sstr = writer.runtime.create_data_store(
+            "default").create_channel("text", "shared-string")
+        sstr.insert_text(0, "read-storm seed ")
+        deadline = _time.monotonic() + 30
+        while writer.runtime.pending.count and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert writer.runtime.pending.count == 0, "writer never quiesced"
+
+        k = 60
+        baseline = probe(k)
+
+        pre_core = _query_counters(core_port)
+        add_readers(n // 10, gw2_port)
+        g1_pre, g2_pre = gw_counters(gw1_port), gw_counters(gw2_port)
+        lat_small = window(k)
+        g1_mid = gw_counters(gw1_port)
+
+        add_readers(n - n // 10, gw2_port)
+        post_core = _query_counters(core_port)
+        # every raw subscriber must have landed as a READONLY session at
+        # the core (an error-reply handshake would count bytes too)
+        ro = (post_core.get("session.readonly.connects", 0)
+              - pre_core.get("session.readonly.connects", 0))
+        assert ro == n, f"expected {n} readonly connects at core, got {ro}"
+        g1_mid2 = gw_counters(gw1_port)  # re-mark: exclude connect burst
+        lat_full = window(k)
+        g1_post, g2_post = gw_counters(gw1_port), gw_counters(gw2_port)
+
+        bpo_small = (g1_mid.get("fanout.upstream.bytes", 0)
+                     - g1_pre.get("fanout.upstream.bytes", 0)) / k
+        bpo_full = (g1_post.get("fanout.upstream.bytes", 0)
+                    - g1_mid2.get("fanout.upstream.bytes", 0)) / k
+        assert bpo_small > 0, "no core egress reached gw1 (dead relay?)"
+        growth = bpo_full / bpo_small
+        assert growth <= 1.5, \
+            f"core bytes/op grew {growth:.2f}x over 10x subscribers"
+
+        # zero re-encode invariant above the core — ALWAYS asserted
+        for name, g in (("gw1", g1_post), ("gw2", g2_post)):
+            enc = g.get("fanout.relay.encodes", 0)
+            assert enc == 0, f"{name} re-encoded {enc} fan-out frames"
+        splices = (g2_post.get("fanout.relay.splices", 0)
+                   - g2_pre.get("fanout.relay.splices", 0))
+        assert splices > 0, "relay splice path never engaged at gw2"
+
+        ack_ratio = round(pct(lat_full, 0.99)
+                          / max(pct(baseline, 0.99), 1e-9), 3)
+        if not host_limited:
+            assert ack_ratio <= 1.10, \
+                f"writer ack p99 {ack_ratio}x baseline under full fan-out"
+        return {
+            "target_readers": target,
+            "readers": n,
+            "host_limited": host_limited,
+            "tree_levels": 2,
+            "baseline_p99_ack_ms": pct(baseline, 0.99),
+            "p99_ack_ms_small": pct(lat_small, 0.99),
+            "p99_ack_ms_full": pct(lat_full, 0.99),
+            "ack_p99_vs_baseline_x": ack_ratio,
+            "core_bytes_per_op_small": round(bpo_small, 1),
+            "core_bytes_per_op_full": round(bpo_full, 1),
+            "core_bytes_per_op_growth_x": round(growth, 3),
+            "relay_encodes": 0,
+            "relay_splices_gw2": splices,
+            "readonly_connects": n,
+        }
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in (gw2, gw1, core):
+            if p is not None:
+                p.terminate()
+        for p in (gw2, gw1, core):
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    p.kill()
+
+
 def bench_sharded(knee_rate: float, run_workers, n_cores: int = 2) -> dict:
     """The SHARDED ordering core at the knee geometry (VERDICT r4 #4):
     ``n_cores`` core PROCESSES over placement leases, gateways routing
@@ -1229,6 +1476,7 @@ def main() -> None:
     net = bench_network()
     overload = bench_overload_sweep(net["knee"])
     join_storm = bench_join_storm()
+    read_storm = bench_net_read_storm()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -1345,6 +1593,13 @@ def main() -> None:
                 # vs whole-log replay; encode-once counter-asserted
                 # (per-join snapshot re-encodes == 0)
                 "net_join_storm": join_storm,
+                # read-scale fan-out: 10k-target read-only subscribers
+                # (scaled to host, host_limited when capped) behind a
+                # 2-level relay tree; writer ack p99 vs zero-reader
+                # baseline, core-tier bytes/op across 10x subscriber
+                # growth (~flat asserted), relay re-encodes
+                # counter-asserted 0 above the core
+                "net_read_storm": read_storm,
                 # per-device scaling of the doc-mesh applier lane (docs
                 # axis 1→2→4→8, forced host devices; full artifact in
                 # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh

@@ -36,6 +36,7 @@ BOUNDARY_CLASSES = {
     "net": "network",
     "log": "log",
     "broadcast": "fanout",
+    "relay": "fanout",
     "stage": "stage",
     "partition": "stage",
     "applier": "device",

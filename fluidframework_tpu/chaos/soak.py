@@ -15,7 +15,12 @@ fingerprint identity at quiescence:
 - **Phase B** (socket): clients drive a NetworkFrontEnd over real TCP
   while the driver transport drops / duplicates / reorders / truncates
   their submit frames mid-stream; recovery is the reconnect + rebase +
-  resubmit path. The phase then commits a service summary under a
+  resubmit path. One client rides a relay-tier gateway that gets
+  kill -9'd mid-campaign and respawned on the same port (resubscribe +
+  gap repair), and every client publishes presence cursors through the
+  armed transport — dropped/duplicated cursor frames must be invisible
+  because the coalescing lane is LWW (asserted by a post-disarm burst
+  whose final state every peer must converge to). The phase then commits a service summary under a
   mid-upload crash (retry recovers), and boots late joiners through the
   columnar snapshot plane while served chunk bytes arrive torn or
   withheld — the joiners' hash checks must trip, fall back to the
@@ -38,6 +43,8 @@ import json
 import os
 import random
 import shutil
+import socket
+import subprocess
 import sys
 import tempfile
 import time
@@ -526,6 +533,9 @@ class NetSoakClient:
         self.nacked = False
         self.unresolved: list[int] = []
         self.reconnects = 0
+        #: LWW view of peers' presence: (client_id, type) -> content —
+        #: exactly the state the coalescing lane guarantees converges
+        self.seen_presence: dict = {}
         self.connect()
 
     def connect(self) -> None:
@@ -552,6 +562,20 @@ class NetSoakClient:
                 self._apply(m)
         conn.on_op = self._on_op
         conn.on_nack = self._on_nack
+        conn.on_signal = self._on_signal
+
+    def _on_signal(self, sig) -> None:
+        self.seen_presence[(sig.client_id, sig.type)] = sig.content
+
+    def publish_presence(self, content) -> None:
+        """An ephemeral cursor update through the armed transport; loss
+        and duplication must both be invisible (LWW, no sequencing)."""
+        if self.dead:
+            return
+        try:
+            self.conn.submit_signal(content, type="cursor")
+        except OSError:
+            self.dead = True
 
     def reconnect(self) -> None:
         old_id = self.conn.client_id
@@ -644,6 +668,31 @@ class NetSoakClient:
             and not self.replica.pending
 
 
+def _pick_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_relay(core_port: int, port: int):
+    """A relay-tier gateway as a real OS process, so the kill seam is a
+    genuine kill -9 of a fan-out tier (not a polite shutdown)."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.gateway",
+         "--core-port", str(core_port), "--port", str(port), "--python"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=repo_root)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("LISTENING"):
+        proc.kill()
+        raise RuntimeError(f"relay gateway failed to start: {line!r}")
+    return proc
+
+
 def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
                 n_clients: int = 2) -> tuple[FaultPlane, InvariantMonitor]:
     from ..driver.network import (NetworkDocumentService,
@@ -666,10 +715,22 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
         return ctx["topic"].startswith("deltas/") \
             and isinstance(record, dict) and "abatch" in record
 
+    def signal_frames(ctx):
+        return ctx.get("kind") == "signal"
+
     plane.rule("net.send", "drop", at=4, when=submit_frames)
     plane.rule("net.send", "dup", every=5, times=2, when=submit_frames)
     plane.rule("net.send", "delay", at=9, when=submit_frames)
     plane.rule("net.send", "truncate", at=14, when=submit_frames)
+    # presence lane: drop and duplicate ephemeral cursor frames — the
+    # LWW coalescing lane must make BOTH invisible (no gap repair, no
+    # dedupe bookkeeping; a later publish simply overwrites)
+    plane.rule("net.send", "drop", every=4, times=3, when=signal_frames)
+    plane.rule("net.send", "dup", every=5, times=3, when=signal_frames)
+    # relay-tier kill: one fan-out gateway dies mid-campaign and is
+    # respawned on the same port; its clients must ride reconnect +
+    # gap repair through the fresh tier
+    plane.rule("relay.kill", "down", at=5)
     # columnar segment-tail tears: a power cut mid seg_append leaves
     # ragged bytes the torn-tail scan must cut before the re-append —
     # unlike the rawops torn (record lost, client resubmits), a deltas
@@ -683,23 +744,38 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
     server = LocalServer(log=DurableLog(log_dir))
     monitor.attach(server.log, f"deltas/{TENANT}/{DOC}")
     front = NetworkFrontEnd(server).start_background()
+    relay_port = _pick_port()
+    relay = _spawn_relay(front.port, relay_port)
     uninstall = install(plane, transports=True, server=server)
     uninstall_snap: list = []
     joiners: list = []
     try:
+        # the LAST client rides the relay tier; the rest dial the core
+        # directly — so a relay kill takes out one subscriber path while
+        # the writers keep the stream moving
+        ports = [front.port] * (n_clients - 1) + [relay_port]
         clients = [
             NetSoakClient(
-                NetworkDocumentService("127.0.0.1", front.port, TENANT,
+                NetworkDocumentService("127.0.0.1", ports[i], TENANT,
                                        DOC, counters=counters),
                 monitor, counters, random.Random(seed * 7000 + i),
                 coalesce_window=0.02)
             for i in range(n_clients)]
         rng = random.Random(seed + 2)
-        for _ in range(rounds):
+        for rnd in range(rounds):
             for c in clients:
                 if c.dead or c.nacked:
                     c.reconnect()
                 c.edit(1 + rng.randrange(2))
+                c.publish_presence({"round": rnd})
+            if plane("relay.kill", round=rnd) == "down":
+                # kill -9 the fan-out tier, then bring a fresh one up on
+                # the SAME port: the relay client's reconnect loop must
+                # resubscribe through it and gap-repair what it missed
+                relay.kill()
+                relay.wait(timeout=10)
+                relay = _spawn_relay(front.port, relay_port)
+                counters.inc("chaos.recovered.relay_respawn")
             time.sleep(0.01)
 
         # ---- snapshot fast-boot campaign (plane still armed) ----
@@ -780,6 +856,45 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
             wait_for(lambda: j.delta_manager.last_processed_seq
                      >= server_seq)
 
+        # ---- presence lane: post-disarm final burst, LWW convergence.
+        # Every armed-phase drop/dup of a cursor frame must be invisible
+        # BY DESIGN: a later publish overwrites, so after a clean final
+        # burst every client's last-seen state per peer is the peer's
+        # final publish — no gap repair, no dedupe, no sequencing.
+        ids = [c.conn.client_id for c in clients]
+
+        def _final(i):
+            return {"final": ids[i], "k": 9}
+
+        def _presence_converged():
+            return all(
+                cj.seen_presence.get((ids[i], "cursor")) == _final(i)
+                for i, _ in enumerate(clients)
+                for j, cj in enumerate(clients) if j != i)
+
+        def _burst_and_check():
+            for k in range(10):
+                for i, c in enumerate(clients):
+                    c.publish_presence({"final": ids[i], "k": k})
+            time.sleep(0.05)  # two flush ticks
+            return _presence_converged()
+
+        if not wait_for(_burst_and_check, timeout=20.0, interval=0.05):
+            raise InvariantViolation(
+                "presence lane failed LWW convergence after the "
+                "post-disarm burst — a dropped/duplicated cursor frame "
+                "left visible damage")
+        counters.inc("chaos.recovered.presence_lww")
+        psnap = front.counters.snapshot()
+        if not psnap.get("presence.lane.signals", 0):
+            raise InvariantViolation(
+                "phase B published cursor frames but the presence lane "
+                "never saw one — signals bypassed the coalescing tier")
+        if not psnap.get("presence.lane.coalesced", 0):
+            raise InvariantViolation(
+                "the presence bursts never coalesced — the LWW lane "
+                "went unexercised under faults")
+
         fps = {}
         for i, c in enumerate(clients):
             with c.conn.lock:
@@ -852,6 +967,8 @@ def run_phase_b(seed: int, counters: Counters, rounds: int = 16,
     finally:
         for j in joiners:
             j.close()
+        relay.terminate()
+        relay.wait(timeout=10)
         while uninstall_snap:
             uninstall_snap.pop()()
         uninstall()
@@ -920,7 +1037,22 @@ def _cross_check(counters: Counters) -> None:
         ("chaos.injected.stage.crash", "chaos.recovered.orderer_restart"),
         ("chaos.injected.net.send.truncate",
          "chaos.recovered.net_reconnect"),
-        ("chaos.injected.net.send.drop", "chaos.recovered.net_reconnect"),
+        # a dropped frame is either a submit (reconnect + resubmit) or a
+        # presence cursor (the LWW lane makes the loss invisible — the
+        # convergence check stamps presence_lww when it proves it)
+        ("chaos.injected.net.send.drop",
+         ("chaos.recovered.net_reconnect",
+          "chaos.recovered.presence_lww")),
+        # a duplicated frame is absorbed by seq-dedupe (submits) or by
+        # the presence lane's LWW overwrite (signals)
+        ("chaos.injected.net.send.dup",
+         ("chaos.recovered.client_dedup",
+          "chaos.recovered.presence_lww")),
+        # the relay-tier kill recovers through respawn + the relay
+        # client's reconnect loop
+        ("chaos.injected.relay.kill.down",
+         ("chaos.recovered.relay_respawn",
+          "chaos.recovered.net_reconnect")),
         # snapshot plane: a torn/withheld served chunk must trip the
         # booting client's verify and route it down the legacy-tree
         # fallback; a mid-upload summarizer crash must be absorbed by

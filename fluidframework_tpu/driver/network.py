@@ -107,6 +107,8 @@ class _Transport:
         self._push_handlers: dict[str, Callable[[dict], None]] = {}
         # binary ops batches bypass the dict layer entirely
         self.on_binary_ops: Optional[Callable[[list], None]] = None
+        # coalesced FT_PRESENCE batches (the ephemeral signal lane)
+        self.on_presence: Optional[Callable[[list], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._closed = False
         self._fault = FRAME_FAULT_HOOK
@@ -273,6 +275,15 @@ class _Transport:
                         brid, msgs = binwire.read_cols_deltas(body)
                         self._blocks.setdefault(brid, []).extend(msgs)
                         continue
+                    if body[1] == binwire.FT_PRESENCE:
+                        # coalesced presence batch: one frame, N signals
+                        # (the ephemeral lane — never sequenced)
+                        cb = self.on_presence
+                        if cb is not None:
+                            sigs = binwire.decode_presence(body)
+                            with self.lock:
+                                cb(sigs)
+                        continue
                     cb = self.on_binary_ops
                     if cb is not None:
                         _, msgs = binwire.decode_ops(body)
@@ -320,10 +331,12 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
     def __init__(self, transport: _Transport, tenant_id: str,
                  document_id: str, details: Any = None,
                  token: Optional[str] = None, binary: bool = True,
-                 cache=None, counters: Optional[Counters] = None):
+                 cache=None, counters: Optional[Counters] = None,
+                 readonly: bool = False):
         self._t = transport
         self.lock = transport.lock
         self._binary = binary
+        self.readonly = readonly
         self._tenant = tenant_id
         self._doc = document_id
         self._cache = cache
@@ -373,11 +386,23 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         transport.on_push("nack", self._on_nack_frame)
         transport.on_push("signal", lambda f: self._deliver(
             "signal", message_from_dict(f["signal"])))
+
+        def on_presence(sigs):
+            for s in sigs:
+                self._deliver("signal", s)
+
+        transport.on_presence = on_presence
         transport.on_disconnect = self._fire_disconnect
-        reply = transport.request({
+        connect_frame = {
             "t": "connect", "tenant": tenant_id, "doc": document_id,
             "details": details, "token": token,
-            "bin": 1 if binary else 0})
+            "bin": 1 if binary else 0}
+        if readonly:
+            # fast reader session: no join op is ordered, the clientId
+            # never enters the quorum — the session is free on the core's
+            # op path (boots from snapshot cache + bounded backfill)
+            connect_frame["readonly"] = 1
+        reply = transport.request(connect_frame)
         self.client_id = reply["clientId"]
         self.initial_sequence_number = reply["seq"]
         self.mode = reply.get("mode", "write")
@@ -452,6 +477,10 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                          lambda self, cb: self._set_handler("signal", cb))
 
     def submit(self, messages) -> None:
+        if self.readonly:
+            # fail client-side: a readonly session has no quorum seat
+            # and the server would only scope-nack the op anyway
+            raise RuntimeError("cannot submit on a readonly connection")
         messages = list(messages)
         if not messages:
             return
@@ -878,13 +907,15 @@ class NetworkDocumentService(DocumentService):
     def __init__(self, host: str, port: int, tenant_id: str, document_id: str,
                  timeout: float = 30.0, token_provider=None,
                  binary: bool = True, cache=None,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 readonly: bool = False):
         self._host, self._port, self._timeout = host, port, timeout
         self._tenant = tenant_id
         self._doc = document_id
         self._token_provider = token_provider
         self._binary = binary
         self._cache = cache
+        self._readonly = readonly
         self.counters = (counters if counters is not None
                          else tier_counters("driver"))
         self._rpc: Optional[_Transport] = None
@@ -902,7 +933,8 @@ class NetworkDocumentService(DocumentService):
         conn = NetworkDeltaConnection(t, self._tenant, self._doc, details,
                                       token=token, binary=self._binary,
                                       cache=self._cache,
-                                      counters=self.counters)
+                                      counters=self.counters,
+                                      readonly=self._readonly)
         self._cols_backfill = conn.cols_backfill
         return conn
 
@@ -925,12 +957,14 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  token_provider=None, binary: bool = True,
                  snapshot_cache: bool = True,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 readonly: bool = False):
         from .snapshot_cache import SnapshotCache
 
         self._host, self._port, self._timeout = host, port, timeout
         self._token_provider = token_provider
         self._binary = binary
+        self._readonly = readonly
         # one cache shared by every document of this factory (the
         # odspCache shape); reachable as factory.snapshot_cache for
         # stats/assertions
@@ -948,4 +982,5 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
         return NetworkDocumentService(
             self._host, self._port, tenant_id, document_id, self._timeout,
             token_provider=self._token_provider, binary=self._binary,
-            cache=self.snapshot_cache, counters=self.counters)
+            cache=self.snapshot_cache, counters=self.counters,
+            readonly=self._readonly)

@@ -135,10 +135,11 @@ class DeltaManager:
             except Exception:
                 pass
             raise
-        if getattr(conn, "mode", "write") == "read":
-            # read connections never join the quorum, so there is no join
-            # round-trip to wait for: they go active immediately (and the
-            # write path below refuses their submissions)
+        if getattr(conn, "mode", "write") in ("read", "readonly"):
+            # read/readonly connections never join the quorum, so there
+            # is no join round-trip to wait for: they go active
+            # immediately (and the write path below refuses their
+            # submissions)
             if self._pending_connection is conn:
                 self._activate_connection()
         return conn.client_id
@@ -204,7 +205,12 @@ class DeltaManager:
         """Send one message on the live connection; returns clientSeq."""
         if self.connection is None:
             raise RuntimeError("cannot submit while disconnected")
-        if getattr(self.connection, "mode", "write") == "read":
+        mode = getattr(self.connection, "mode", "write")
+        if mode == "readonly":
+            raise PermissionError(
+                "readonly session: opened with readonly=True, no quorum "
+                "membership to write from")
+        if mode == "read":
             raise PermissionError(
                 "read connection: this client's token lacks doc:write")
         self._remote_since_submit = 0
@@ -321,7 +327,7 @@ class DeltaManager:
         if (
             self.noop_frequency
             and self.connection is not None
-            and getattr(self.connection, "mode", "write") != "read"
+            and getattr(self.connection, "mode", "write") == "write"
             and self._remote_since_submit >= self.noop_frequency
         ):
             self._remote_since_submit = 0

@@ -73,6 +73,7 @@ from .messages import (
     DocumentMessage,
     MessageType,
     SequencedDocumentMessage,
+    Signal,
     TraceHop,
 )
 
@@ -87,6 +88,8 @@ FT_COLS_OPS = 7
 FT_COLS_FOPS = 8
 FT_COLS_DELTAS = 9
 FT_COLS_SNAP = 10
+FT_PRESENCE = 11
+FT_FPRESENCE = 12
 
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
@@ -1051,12 +1054,99 @@ def submit_to_fsubmit(body: bytes, sid: int) -> bytes:
     return _FSUB_HDR.pack(MAGIC, ft, sid) + body[2:]
 
 
+def fsubmit_sid(body: bytes) -> int:
+    """The muxed session id an ``fsubmit`` body is addressed to."""
+    return _U32.unpack_from(body, 2)[0]
+
+
+def fsubmit_rewrite_sid(body: bytes, sid: int) -> bytes:
+    """Relay-tree sid splice: re-address an ``fsubmit`` body to the
+    parent tier's sid without touching the op payload bytes."""
+    return body[:2] + _U32.pack(sid) + body[6:]
+
+
 def fops_strip_topic(body: bytes) -> tuple[str, bytes]:
     """Split an ``fops`` body → (topic, client-facing ``ops`` body)."""
     ft = FT_COLS_OPS if body[1] == FT_COLS_FOPS else FT_OPS
     (tl,) = _U16.unpack_from(body, 2)
     topic = body[4:4 + tl].decode()
     return topic, bytes((MAGIC, ft)) + body[4 + tl:]
+
+
+def fpresence_strip_topic(body: bytes) -> tuple[str, bytes]:
+    """Split an ``fpresence`` body → (topic, client ``presence`` body)."""
+    (tl,) = _U16.unpack_from(body, 2)
+    topic = body[4:4 + tl].decode()
+    return topic, bytes((MAGIC, FT_PRESENCE)) + body[4 + tl:]
+
+
+# ----------------------------------------------------- presence frames
+# The ephemeral lane: coalesced signal batches, never sequenced, never
+# logged. Batch section is IDENTICAL between FT_PRESENCE (client form)
+# and FT_FPRESENCE (backbone form, u16-len topic prefix) so a gateway
+# relays presence down the tree with the same topic-slice byte splice
+# as fops — zero re-encode at every level.
+#
+#     batch := u16 n; n × entry
+#     entry := u16 cid_len (0xFFFF = None) + utf8 cid,
+#              u16 type_len + utf8 type,
+#              u32 content_len + utf8 content-JSON
+
+
+def encode_presence(signals, topic: Optional[str] = None) -> bytes:
+    """Signal batch → FT_PRESENCE body, or FT_FPRESENCE when ``topic``
+    is given (the backbone form a gateway strips without decoding)."""
+    out = []
+    if topic is None:
+        out.append(bytes((MAGIC, FT_PRESENCE)))
+    else:
+        t = topic.encode()
+        out.append(bytes((MAGIC, FT_FPRESENCE)) + _U16.pack(len(t)) + t)
+    out.append(_U16.pack(len(signals)))
+    for sig in signals:
+        cid = sig.client_id
+        if cid is None:
+            out.append(_U16.pack(_NONE_IDX))
+        else:
+            c = cid.encode()
+            out.append(_U16.pack(len(c)))
+            out.append(c)
+        t = sig.type.encode()
+        out.append(_U16.pack(len(t)))
+        out.append(t)
+        body = json.dumps(sig.content, separators=(",", ":")).encode()
+        out.append(_U32.pack(len(body)))
+        out.append(body)
+    return b"".join(out)
+
+
+def decode_presence(body: bytes):
+    """FT_PRESENCE / FT_FPRESENCE body → list of Signal."""
+    off = 2
+    if body[1] == FT_FPRESENCE:
+        (tl,) = _U16.unpack_from(body, off)
+        off += 2 + tl
+    (n,) = _U16.unpack_from(body, off)
+    off += 2
+    sigs = []
+    for _ in range(n):
+        (cl,) = _U16.unpack_from(body, off)
+        off += 2
+        if cl == _NONE_IDX:
+            cid = None
+        else:
+            cid = body[off:off + cl].decode()
+            off += cl
+        (tl,) = _U16.unpack_from(body, off)
+        off += 2
+        typ = body[off:off + tl].decode()
+        off += tl
+        (bl,) = _U32.unpack_from(body, off)
+        off += 4
+        content = json.loads(body[off:off + bl].decode())
+        off += bl
+        sigs.append(Signal(client_id=cid, type=typ, content=content))
+    return sigs
 
 
 def is_binary(body: bytes) -> bool:

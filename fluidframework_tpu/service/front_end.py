@@ -62,12 +62,13 @@ from typing import Any, Optional
 
 from ..obs import get_recorder, get_registry, tier_counters
 from ..protocol import binwire
-from ..protocol.messages import Nack, NackErrorType, TraceHop
+from ..protocol.messages import Nack, NackErrorType, Signal, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
 from ..utils.telemetry import HOP_ADMIT, HOP_SERVICE_ACTION, hop_pairs
 from .admission import AdmissionController, retry_after_ms
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
+from .presence import PresenceLane
 from .scriptorium import LogTruncatedError
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
@@ -192,6 +193,8 @@ class _ClientSession:
         self._ftopics: dict[str, object] = {}  # topic → pubsub callbacks
         self._ftopic_refs: dict[str, int] = {}
         self._fsession_topics: dict[int, str] = {}
+        # presence-lane subscriptions this session holds: (topic, fn)
+        self._presence: list = []
 
     # -- push events (called synchronously from the pipeline drain, which
     # runs on the loop thread) --
@@ -341,6 +344,23 @@ class _ClientSession:
         except RuntimeError:
             pass
 
+    def _subscribe_presence(self, topic: str) -> None:
+        """Register this direct session on the doc's presence lane: one
+        shared FT_PRESENCE frame for binary clients, legacy per-signal
+        JSON otherwise."""
+        if any(t == topic for t, _ in self._presence):
+            return  # reconnect on a live socket: already registered
+
+        def on_presence(pb):
+            if self.binary:
+                self.push_raw(pb.presence_frame())
+            else:
+                for d in pb.signal_dicts():
+                    self.push("signal", {"signal": d})
+
+        self.front.presence.subscribe(topic, on_presence)
+        self._presence.append((topic, on_presence))
+
     def handle(self, frame: dict) -> None:
         t = frame.get("t")
         rid = frame.get("rid")
@@ -348,12 +368,20 @@ class _ClientSession:
             if t == "connect":
                 server = self.front.server_for(frame["tenant"],
                                                frame["doc"])
+                readonly = bool(frame.get("readonly"))
                 conn = server.connect(
                     frame["tenant"], frame["doc"], frame.get("details"),
-                    token=frame.get("token"))
-                self.front._dirty_servers.add(server)  # join was appended
+                    token=frame.get("token"), readonly=readonly)
+                if readonly:
+                    # no join was ordered: nothing to flush, nothing on
+                    # the op path — the whole point of the reader tier
+                    self.front.counters.inc("session.readonly.connects")
+                else:
+                    self.front._dirty_servers.add(server)  # join appended
                 self.conn = conn
                 self.binary = bool(frame.get("bin"))
+                self._subscribe_presence(
+                    f"{frame['tenant']}/{frame['doc']}")
                 # a broadcast batch rides the wire as ONE frame — at load
                 # the per-op frame overhead (json + syscall each) was the
                 # front end's dominant cost
@@ -390,8 +418,14 @@ class _ClientSession:
             elif t == "signal":
                 if self.conn is None:
                     raise RuntimeError("signal before connect")
-                self.conn.submit_signal(frame["content"],
-                                        frame.get("type", "signal"))
+                # presence lane, not submit_signal: coalesce per
+                # (doc, client, type) server-side and deliver batched on
+                # the flush tick — never touches deli or the durable log
+                self.front.presence.publish(
+                    f"{self.conn.tenant_id}/{self.conn.document_id}",
+                    Signal(client_id=self.conn.client_id,
+                           type=frame.get("type", "signal"),
+                           content=frame["content"]))
             elif t == "disconnect":
                 if self.conn is not None:
                     self.front._dirty_servers.add(self.conn.server)
@@ -773,11 +807,28 @@ class _ClientSession:
                     self.push("fsignal", {
                         "topic": topic, "signal": message_to_dict(sig)})
                 server.pubsub.subscribe(f"signal/{tenant}/{doc}", on_signal)
+
+                def on_presence(pb, topic=topic):
+                    # one FT_FPRESENCE frame per flush shared by every
+                    # backbone link; relays strip the topic by splice
+                    if self._fbinary:
+                        self.push_raw(pb.fpresence_frame())
+                    else:
+                        for d in pb.signal_dicts():
+                            self.push("fsignal",
+                                      {"topic": topic, "signal": d})
+                self.front.presence.subscribe(topic, on_presence)
                 self._ftopics[topic] = (on_batch, on_signal,
-                                        f"signal/{tenant}/{doc}", server)
+                                        f"signal/{tenant}/{doc}", server,
+                                        on_presence)
+            readonly = bool(frame.get("readonly"))
             conn = server.connect(tenant, doc, frame.get("details"),
-                                  token=frame.get("token"))
-            self.front._dirty_servers.add(server)  # join was appended
+                                  token=frame.get("token"),
+                                  readonly=readonly)
+            if readonly:
+                self.front.counters.inc("session.readonly.connects")
+            else:
+                self.front._dirty_servers.add(server)  # join was appended
             self._fsessions[sid] = conn
             self._fsession_topics[sid] = topic
             self._ftopic_refs[topic] = self._ftopic_refs.get(topic, 0) + 1
@@ -808,7 +859,11 @@ class _ClientSession:
                 self.front._dirty_servers.add(conn.server)
         elif t == "fsignal":
             conn = self._fsessions[frame["sid"]]
-            conn.submit_signal(frame["content"], frame.get("type", "signal"))
+            self.front.presence.publish(
+                f"{conn.tenant_id}/{conn.document_id}",
+                Signal(client_id=conn.client_id,
+                       type=frame.get("type", "signal"),
+                       content=frame["content"]))
         elif t == "fdisconnect":
             sid = frame["sid"]
             conn = self._fsessions.pop(sid, None)
@@ -1099,10 +1154,11 @@ class _ClientSession:
     def _unsubscribe_ftopic(self, topic: str) -> None:
         entry = self._ftopics.pop(topic, None)
         if entry is not None:
-            on_batch, on_signal, sig_topic, server = entry
+            on_batch, on_signal, sig_topic, server, on_presence = entry
             pubsub = server.pubsub
             pubsub.unsubscribe(topic, on_batch)
             pubsub.unsubscribe(sig_topic, on_signal)
+            self.front.presence.unsubscribe(topic, on_presence)
 
     def drop_server(self, server) -> None:
         """Tear down everything this session holds on a revoked
@@ -1142,6 +1198,9 @@ class _ClientSession:
         self._ftopic_refs.clear()
         for topic in list(self._ftopics):
             self._unsubscribe_ftopic(topic)
+        for topic, fn in self._presence:
+            self.front.presence.unsubscribe(topic, fn)
+        self._presence.clear()
 
 
 class ShardHost:
@@ -1383,6 +1442,10 @@ class NetworkFrontEnd:
         # net.fanout.*), served read-only by the admin_counters RPC and
         # aggregated under tier="frontend" by the registry scrape
         self.counters = tier_counters("frontend")
+        # ephemeral signal tier: network-origin signals coalesce here
+        # per (doc, client, type) and batch out on the presence tick —
+        # they never touch deli or the durable log (service/presence.py)
+        self.presence = PresenceLane(self.counters)
         # partition servers dirtied by the current ingress batch; the
         # batch flushes exactly these (see _flush_dirty)
         self._dirty_servers: set = set()
@@ -1640,6 +1703,18 @@ class NetworkFrontEnd:
             self._summarizers[id(server)] = summ
         return summ
 
+    async def _presence_loop(self) -> None:
+        """The presence tick: drain the LWW store to watchers. Runs on
+        the serving loop AFTER any already-queued op pushes, so presence
+        never overtakes a sequenced op it followed."""
+        lane = self.presence
+        while True:
+            await asyncio.sleep(lane.flush_interval)
+            try:
+                lane.flush()
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("presence_flush_error", message=str(e))
+
     async def _summarize_loop(self, interval: float = 0.05) -> None:
         while True:
             try:
@@ -1698,6 +1773,8 @@ class NetworkFrontEnd:
         if self.summarize_every is not None:
             self._bg_tasks.append(asyncio.get_running_loop().create_task(
                 self._summarize_loop()))
+        self._bg_tasks.append(asyncio.get_running_loop().create_task(
+            self._presence_loop()))
         if self.shard_host is not None:
             loop = asyncio.get_running_loop()
 

@@ -278,6 +278,7 @@ class LocalServer:
         details: Any = None,
         can_evict: bool = True,
         token: Optional[str] = None,
+        readonly: bool = False,
     ) -> ServerConnection:
         """The connect_document handshake: join the quorum, get a live
         connection primed at the current sequence number. With a tenant
@@ -285,20 +286,27 @@ class LocalServer:
         any document state is touched (ref: alfred connect_document →
         tenantManager.verifyToken). A doc:read-only token gets a READ
         connection: it may watch the stream, but submits are nacked with
-        InvalidScopeError (ref: readonly connections, tokens.ts scopes)."""
+        InvalidScopeError (ref: readonly connections, tokens.ts scopes).
+
+        ``readonly=True`` requests the fast reader session regardless of
+        token scope: no join op is ordered, the clientId never enters
+        the quorum, and the session costs the op path nothing — the
+        audience tier for read-scale fan-out."""
         self._check_revoked()
-        can_write = True
+        can_write = not readonly
         if self.tenants is not None:
             from .tenants import SCOPE_READ, SCOPE_WRITE
 
             claims = self.tenants.validate(token, tenant_id, document_id,
                                            required_scope=SCOPE_READ)
-            can_write = SCOPE_WRITE in claims.get("scopes", [])
+            can_write = can_write and SCOPE_WRITE in claims.get(
+                "scopes", [])
         orderer = self._get_orderer(tenant_id, document_id)
         client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
         conn.can_write = can_write
-        conn.mode = "write" if can_write else "read"
+        conn.mode = "readonly" if readonly else (
+            "write" if can_write else "read")
 
         topic = BroadcasterLambda.topic(tenant_id, document_id)
         conn._op_cb = conn._deliver_ops  # op topics carry batches

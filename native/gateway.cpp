@@ -66,6 +66,8 @@ constexpr uint8_t kFtColsSubmit = 5;
 constexpr uint8_t kFtColsFsubmit = 6;
 constexpr uint8_t kFtColsOps = 7;
 constexpr uint8_t kFtColsFops = 8;
+constexpr uint8_t kFtPresence = 11;
+constexpr uint8_t kFtFpresence = 12;
 constexpr size_t kMaxFrame = 8u * 1024 * 1024;     // front_end.py MAX_FRAME
 constexpr size_t kMaxBuffered = 32u * 1024 * 1024; // slow-consumer drop
 
@@ -905,6 +907,56 @@ void fan_out(Gateway* g, const std::string& topic, const std::string& frame) {
   }
 }
 
+// Decode a presence body (01 0B u16 n; n x [u16 cidlen cid (0xFFFF =
+// null), u16 typelen type, u32 clen content-json]) into concatenated
+// legacy {"t":"signal"} frames for a JSON session. The content span is
+// already JSON and splices verbatim. Empty string on malformed input.
+std::string presence_body_to_json_frames(const uint8_t* b, size_t len) {
+  if (len < 4) return "";
+  auto u16 = [&](size_t o) -> uint32_t {
+    return ((uint32_t)b[o] << 8) | b[o + 1];
+  };
+  size_t off = 2;
+  uint32_t n = u16(off);
+  off += 2;
+  std::string out;
+  for (uint32_t i = 0; i < n; ++i) {
+    if (off + 2 > len) return "";
+    uint32_t cl = u16(off);
+    off += 2;
+    bool has_cid = cl != 0xFFFF;
+    std::string cid;
+    if (has_cid) {
+      if (off + cl > len) return "";
+      cid.assign((const char*)b + off, cl);
+      off += cl;
+    }
+    if (off + 2 > len) return "";
+    uint32_t tl = u16(off);
+    off += 2;
+    if (off + tl > len) return "";
+    std::string type((const char*)b + off, tl);
+    off += tl;
+    if (off + 4 > len) return "";
+    uint32_t clen = ((uint32_t)b[off] << 24) | ((uint32_t)b[off + 1] << 16) |
+                    ((uint32_t)b[off + 2] << 8) | b[off + 3];
+    off += 4;
+    if (off + clen > len) return "";
+    std::string sig =
+        "{\"t\":\"signal\",\"signal\":{\"_kind\":\"signal\",\"client_id\":";
+    if (has_cid) append_json_str(&sig, cid);
+    else sig += "null";
+    sig += ",\"type\":";
+    append_json_str(&sig, type);
+    sig += ",\"content\":";
+    sig.append((const char*)b + off, clen);
+    off += clen;
+    sig += "}}";
+    out += make_frame(sig);
+  }
+  return out;
+}
+
 void handle_upstream_frame(Gateway* g, const char* body, size_t len) {
   if (len >= 2 && (uint8_t)body[0] == kMagic) {
     uint8_t ft = (uint8_t)body[1];
@@ -951,6 +1003,42 @@ void handle_upstream_frame(Gateway* g, const char* body, size_t len) {
             continue;
           }
           send_to(g, s, json_frame);
+        }
+      }
+    } else if (ft == kFtFpresence && len >= 4) {
+      // 01 0C u16 tlen topic <batch> -> topic, frame(01 0B <batch>):
+      // the presence lane's coalesced flush relays by the same topic
+      // strip as fops — the batch bytes are never decoded for binary
+      // subscribers
+      size_t tlen = ((size_t)(uint8_t)body[2] << 8) | (uint8_t)body[3];
+      if (4 + tlen > len) return;
+      std::string topic(body + 4, tlen);
+      std::string pbody;
+      pbody.reserve(len - 4 - tlen + 2);
+      pbody.push_back((char)kMagic);
+      pbody.push_back((char)kFtPresence);
+      pbody.append(body + 4 + tlen, len - 4 - tlen);
+      std::string bin_frame = make_frame(pbody);
+      auto it = g->topics.find(topic);
+      if (it == g->topics.end()) return;
+      std::string json_frames;  // lazily decoded once per flush
+      bool json_tried = false;
+      std::vector<int> fds(it->second.begin(), it->second.end());
+      for (int fd : fds) {
+        auto sit = g->sessions.find(fd);
+        if (sit == g->sessions.end()) continue;
+        Session* s = &sit->second;
+        if (s->binary) {
+          send_to(g, s, bin_frame);
+        } else {
+          if (!json_tried) {
+            json_tried = true;
+            json_frames = presence_body_to_json_frames(
+                (const uint8_t*)pbody.data(), pbody.size());
+          }
+          // presence is ephemeral: a malformed batch drops silently —
+          // unlike ops there is no sequence gap to stall on
+          if (!json_frames.empty()) send_to(g, s, json_frames);
         }
       }
     }

@@ -389,6 +389,50 @@ def test_applier_family_lock_caught(tmp_path):
     assert "applier.stage.seconds" in vs[0].message
 
 
+def test_fanout_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('fanout.relay.reencodes')\n")  # typo'd member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "fanout.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "fanout.relay.encodes" in vs[0].message
+
+
+def test_presence_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('presence.lane.coalesces')\n")  # not a member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "presence.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "presence.lane.coalesced" in vs[0].message
+
+
+def test_readonly_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('session.readonly.opens')\n")
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 \
+        and 'locked "session.readonly.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+
+
+def test_fanout_prefix_does_not_lock_net_fanout(tmp_path):
+    # the front end's encode-once cache counters live under
+    # "net.fanout.*" — the "fanout." lock must not swallow them
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('net.fanout.encodes')\n"
+        "    c.inc('net.fanout.cache_hits')\n")
+    assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
+
+
 def test_boot_family_members_pass(tmp_path):
     path = _metrics_file(
         tmp_path,
@@ -397,7 +441,10 @@ def test_boot_family_members_pass(tmp_path):
         "    c.inc('boot.backfill.bounded')\n"
         "    c.inc('storage.snapshot.served')\n"
         "    c.inc('placement.epoch.bumps')\n"
-        "    c.inc('applier.stage.overlap_ratio')\n")
+        "    c.inc('applier.stage.overlap_ratio')\n"
+        "    c.inc('fanout.relay.splices')\n"
+        "    c.inc('presence.lane.coalesced')\n"
+        "    c.inc('session.readonly.connects')\n")
     assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
 
 

@@ -1,0 +1,379 @@
+"""Read-scale fan-out (ISSUE 12): relay-tree gateway tiers, read-only
+fast sessions, and the coalesced presence lane.
+
+Three planes under test:
+
+- the :class:`~fluidframework_tpu.service.presence.PresenceLane` — LWW
+  coalescing per (doc, client, type), flush-tick batching, and the
+  ordering contract against sequenced ops;
+- ``readonly`` sessions — no join op, no quorum membership, submit
+  refused at the driver, ``session.readonly.connects`` counted;
+- the relay tree — a gateway whose upstream is another gateway
+  (``--upstream-gateway``), including the mid-tier-kill resubscribe
+  with the exact-once substring audit.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.driver import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.obs import tier_counters
+from fluidframework_tpu.protocol import binwire
+from fluidframework_tpu.protocol.messages import MessageType, Signal
+from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+from fluidframework_tpu.service.presence import PresenceLane
+
+
+def wait_for(pred, timeout=15.0, interval=0.005):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            if pred():
+                return True
+        except (KeyError, IndexError):
+            pass
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------------ presence lane
+
+def _lane():
+    return PresenceLane(tier_counters("presence_test"))
+
+
+def test_presence_lww_coalesces_per_client_and_type():
+    lane = _lane()
+    got = []
+    lane.subscribe("t/d", got.append)
+    for i in range(10):
+        lane.publish("t/d", Signal(client_id="c1", type="cursor",
+                                   content={"i": i}))
+    lane.publish("t/d", Signal(client_id="c2", type="cursor",
+                               content={"i": 99}))
+    lane.publish("t/d", Signal(client_id="c1", type="select",
+                               content={"s": 1}))
+    lane.flush()
+    assert len(got) == 1  # one batch per subscriber per flush
+    sigs = {(s.client_id, s.type): s.content for s in got[0].signals}
+    # the 10 cursor moves from c1 collapsed to the LAST one
+    assert sigs == {("c1", "cursor"): {"i": 9},
+                    ("c2", "cursor"): {"i": 99},
+                    ("c1", "select"): {"s": 1}}
+    snap = lane.counters.snapshot()
+    assert snap["presence.lane.coalesced"] == 9
+    assert snap["presence.lane.signals"] == 12
+
+
+def test_presence_flush_batches_and_unwatched_topics_evaporate():
+    lane = _lane()
+    got_a, got_b = [], []
+    sub_a, sub_b = got_a.append, got_b.append
+    lane.subscribe("t/a", sub_a)
+    lane.subscribe("t/a", sub_b)
+    lane.publish("t/a", Signal(client_id="x", type="s", content=1))
+    lane.publish("t/nobody", Signal(client_id="x", type="s", content=2))
+    delivered = lane.flush()
+    assert delivered == 2  # both t/a subscribers, nobody for t/nobody
+    # the two subscribers share ONE batch object: encodings are shared
+    assert got_a[0] is got_b[0]
+    # nothing pending: flush is a no-op, not an empty broadcast
+    assert lane.flush() == 0
+    lane.unsubscribe("t/a", sub_a)
+    assert lane.watching("t/a")  # sub_b still there
+    lane.unsubscribe("t/a", sub_b)
+    assert not lane.watching("t/a")
+
+
+def test_presence_batch_encodes_once_per_wire_form():
+    lane = _lane()
+    batches = []
+    lane.subscribe("t/d", batches.append)
+    lane.publish("t/d", Signal(client_id="c", type="s", content={"k": 1}))
+    lane.flush()
+    pb = batches[0]
+    assert pb.presence_frame() is pb.presence_frame()
+    assert pb.fpresence_frame() is pb.fpresence_frame()
+    assert pb.signal_dicts() is pb.signal_dicts()
+
+
+def test_binwire_presence_roundtrip_and_topic_splice():
+    sigs = [Signal(client_id="c1", type="cursor", content={"x": 3}),
+            Signal(client_id=None, type="system", content=[1, "two"])]
+    body = binwire.encode_presence(sigs)
+    out = binwire.decode_presence(body)
+    assert [(s.client_id, s.type, s.content) for s in out] \
+        == [(s.client_id, s.type, s.content) for s in sigs]
+    # the backbone form strips to the EXACT client form by byte splice
+    fbody = binwire.encode_presence(sigs, topic="t/d")
+    topic, stripped = binwire.fpresence_strip_topic(fbody)
+    assert topic == "t/d"
+    assert stripped == body
+    out2 = binwire.decode_presence(fbody)  # decodable with topic too
+    assert [s.content for s in out2] == [s.content for s in sigs]
+
+
+# --------------------------------------------------------- readonly sessions
+
+def test_readonly_connect_orders_no_join():
+    server = LocalServer()
+    w = server.connect("t", "d", None)
+    seen = []
+    w.on_op = seen.append
+    r = server.connect("t", "d", None, readonly=True)
+    assert r.mode == "readonly"
+    w2 = server.connect("t", "d", None)  # control: a writer DOES join
+    assert wait_for(lambda: any(
+        m.type == "join" and w2.client_id in str(m.contents)
+        for m in seen))
+    # the readonly client's id never entered the op stream
+    assert not any(r.client_id in str(m.contents) for m in seen)
+
+
+def test_readonly_network_session_reads_but_cannot_write():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    try:
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", fe.port)).resolve("t", "rdoc")
+        s1 = writer.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s1.insert_text(0, "read scale")
+        reader = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", fe.port, readonly=True)).resolve("t", "rdoc")
+        assert wait_for(lambda: reader.runtime.get_data_store("default")
+                        .get_channel("text").get_text() == "read scale")
+        assert fe.counters.snapshot()["session.readonly.connects"] == 1
+        # a reader costs the quorum nothing: no join was ordered for it
+        assert reader.delta_manager.connection.mode == "readonly"
+        with pytest.raises(PermissionError):
+            reader.delta_manager.submit(MessageType.OPERATION, {"x": 1})
+    finally:
+        fe.stop()
+
+
+def test_readonly_live_tail_and_presence_publish():
+    """A reader keeps tailing live edits AND may publish presence
+    (viewers broadcast cursors without quorum membership)."""
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    try:
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", fe.port)).resolve("t", "taildoc")
+        s1 = writer.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s1.insert_text(0, "a")
+        reader = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", fe.port, readonly=True)).resolve("t", "taildoc")
+        got = []
+        writer.on_signal = lambda sig: got.append(sig)
+        s1.insert_text(1, "b")  # live edit AFTER the reader booted
+        assert wait_for(lambda: reader.runtime.get_data_store("default")
+                        .get_channel("text").get_text() == "ab")
+        reader.submit_signal({"cursor": 7}, type="cursor")
+        assert wait_for(lambda: any(
+            s.content == {"cursor": 7} and s.type == "cursor"
+            for s in got))
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------- presence over the wire
+
+def test_signal_burst_coalesces_server_side():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    try:
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", fe.port))
+        c1 = loader.resolve("t", "sigdoc")
+        c2 = loader.resolve("t", "sigdoc")
+        got = []
+        c2.on_signal = lambda sig: got.append(sig)
+        for i in range(50):
+            c1.submit_signal({"i": i}, type="cursor")
+        # the LAST write always lands (LWW), and the burst coalesced:
+        # far fewer deliveries than publishes
+        assert wait_for(lambda: any(
+            s.content == {"i": 49} for s in got if s.type == "cursor"))
+        snap = fe.counters.snapshot()
+        assert snap["presence.lane.coalesced"] > 0
+        assert len([s for s in got if s.type == "cursor"]) < 50
+        assert snap["presence.lane.flushes"] >= 1
+    finally:
+        fe.stop()
+
+
+def test_presence_never_overtakes_sequenced_ops():
+    fe = NetworkFrontEnd(LocalServer()).start_background()
+    try:
+        loader = Loader(NetworkDocumentServiceFactory("127.0.0.1", fe.port))
+        c1 = loader.resolve("t", "orderdoc")
+        c2 = loader.resolve("t", "orderdoc")
+        s1 = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s2_text_at_signal = []
+        c2.on_signal = lambda sig, c2=c2: s2_text_at_signal.append(
+            c2.runtime.get_data_store("default")
+            .get_channel("text").get_text()) if sig.type == "mark" else None
+        for i in range(20):
+            s1.insert_text(len(s1.get_text()), f"{i % 10}")
+        c1.submit_signal({"done": True}, type="mark")
+        assert wait_for(lambda: len(s2_text_at_signal) >= 1)
+        # the signal was submitted after 20 inserts; when it arrives,
+        # every one of those ops has already been applied at c2
+        assert s2_text_at_signal[0] == "01234567890123456789"
+    finally:
+        fe.stop()
+
+
+# -------------------------------------------------------------- relay tree
+
+def _spawn(args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd="/root/repo")
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.rsplit(":", 1)[1])
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def tree():
+    """core ← mid gateway ← leaf gateway, all separate OS processes.
+
+    The mid tier runs the asyncio relay (it SERVES the backbone
+    protocol to the leaf); the leaf dials it with --upstream-gateway."""
+    core, core_port = _spawn(
+        ["fluidframework_tpu.service.front_end", "--port", "0"])
+    mid, p_mid = _spawn(["fluidframework_tpu.service.gateway",
+                         "--core-port", str(core_port), "--python"])
+    leaf, p_leaf = _spawn(["fluidframework_tpu.service.gateway",
+                           "--upstream-gateway", f"127.0.0.1:{p_mid}"])
+    try:
+        yield core_port, p_mid, p_leaf
+    finally:
+        for proc in (leaf, mid, core):
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+def test_relay_tree_converges_both_ways(tree):
+    core_port, _, p_leaf = tree
+    c1 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", core_port)).resolve("t", "treedoc")
+    c2 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", p_leaf)).resolve("t", "treedoc")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "root")
+    assert wait_for(lambda: c2.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "root")
+    s2 = c2.runtime.get_data_store("default").get_channel("text")
+    s2.insert_text(4, " leaf")  # write path climbs two tiers
+    assert wait_for(lambda: s1.get_text() == "root leaf"
+                    and s2.get_text() == "root leaf")
+
+
+def test_signals_traverse_the_tree(tree):
+    core_port, _, p_leaf = tree
+    c1 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", core_port)).resolve("t", "treesig")
+    c2 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", p_leaf)).resolve("t", "treesig")
+    got_down, got_up = [], []
+    c2.on_signal = lambda sig: got_down.append(sig.content)
+    c1.on_signal = lambda sig: got_up.append(sig.content)
+    c1.submit_signal({"from": "root"})
+    c2.submit_signal({"from": "leaf"})
+    assert wait_for(lambda: {"from": "root"} in got_down)
+    assert wait_for(lambda: {"from": "leaf"} in got_up)
+
+
+def test_readonly_reader_through_the_tree(tree):
+    core_port, _, p_leaf = tree
+    c1 = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", core_port)).resolve("t", "treero")
+    s1 = c1.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    s1.insert_text(0, "fan out")
+    reader = Loader(NetworkDocumentServiceFactory(
+        "127.0.0.1", p_leaf, readonly=True)).resolve("t", "treero")
+    assert wait_for(lambda: reader.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "fan out")
+    assert reader.delta_manager.connection.mode == "readonly"
+    s1.insert_text(len(s1.get_text()), " live")  # reader keeps tailing
+    assert wait_for(lambda: reader.runtime.get_data_store("default")
+                    .get_channel("text").get_text() == "fan out live")
+
+
+@pytest.mark.slow
+def test_midtier_gateway_kill_exact_once_delivery():
+    """Kill the MID tier under live traffic; every marker written before,
+    during, and after the outage must appear at the leaf's reader
+    exactly once (the net_smoke audit: ``text.count(marker) != 1``)."""
+    n_ops = 60
+    core, core_port = _spawn(
+        ["fluidframework_tpu.service.front_end", "--port", "0"])
+    p_mid = _free_port()
+    mid, _ = _spawn(["fluidframework_tpu.service.gateway",
+                     "--core-port", str(core_port),
+                     "--port", str(p_mid), "--python"])
+    leaf, p_leaf = _spawn(["fluidframework_tpu.service.gateway",
+                           "--upstream-gateway", f"127.0.0.1:{p_mid}"])
+    try:
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", core_port)).resolve("t", "killdoc")
+        s1 = writer.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        reader = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", p_leaf), auto_reconnect=True).resolve(
+            "t", "killdoc")
+
+        def rtext():
+            return (reader.runtime.get_data_store("default")
+                    .get_channel("text").get_text())
+
+        def write(i):
+            s1.insert_text(len(s1.get_text()), f"m{i:03d} ")
+
+        for i in range(20):
+            write(i)
+        assert wait_for(lambda: rtext().count("m019 ") == 1)
+        mid.kill()  # crash, not graceful shutdown
+        mid.wait(timeout=10)
+        for i in range(20, 40):
+            write(i)  # written while the reader's tier is dark
+        mid2, _ = _spawn(["fluidframework_tpu.service.gateway",
+                          "--core-port", str(core_port),
+                          "--port", str(p_mid), "--python"])
+        try:
+            for i in range(40, n_ops):
+                write(i)
+            # resubscribe + driver catch-up repair the gap: exactly-once
+            assert wait_for(
+                lambda: rtext().count(f"m{n_ops - 1:03d} ") == 1,
+                timeout=30.0)
+            text = rtext()
+            lost = [i for i in range(n_ops)
+                    if text.count(f"m{i:03d} ") != 1]
+            assert not lost, f"lost-or-duplicated markers: {lost}"
+        finally:
+            mid2.terminate()
+            mid2.wait(timeout=10)
+    finally:
+        for proc in (leaf, core):
+            proc.terminate()
+            proc.wait(timeout=10)

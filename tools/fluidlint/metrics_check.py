@@ -91,6 +91,25 @@ LOCKED_FAMILIES = {
                              "placement.migration.committed",
                              "placement.migration.failed",
                              "placement.migration.adopted"}),
+    # the read-scale fan-out tier (ISSUE 12): the net-smoke relay gate
+    # counter-asserts splices > 0 and encodes == 0 above the first
+    # gateway level, and the read-storm bench keys on upstream bytes —
+    # these exact names are the relay tree's perf contract
+    # (service/gateway.py). NOTE: "fanout." does not collide with the
+    # front end's "net.fanout.*" cache counters — prefixes match from
+    # the name's start.
+    "fanout.": frozenset({"fanout.relay.splices",
+                          "fanout.relay.encodes",
+                          "fanout.upstream.frames",
+                          "fanout.upstream.bytes"}),
+    # the ephemeral presence lane: the soak's drop/dup rules prove loss
+    # is invisible BECAUSE coalescing happens, which only these names
+    # witness (service/presence.py)
+    "presence.": frozenset({"presence.lane.signals",
+                            "presence.lane.coalesced",
+                            "presence.lane.flushes",
+                            "presence.lane.delivered"}),
+    "session.readonly.": frozenset({"session.readonly.connects"}),
 }
 
 

@@ -35,7 +35,12 @@ amortization points of the socket tier (see ARCHITECTURE.md
   a gateway, ``admin_migrate_doc`` fired mid-stream): every submitted
   op must ack exactly once (zero lost), and the source core's
   ``placement.migration.committed`` / ``placement.epoch.bumps``
-  counters must be nonzero.
+  counters must be nonzero;
+- a 2-level relay tree (core ← gw1 ← gw2) with read-only leaf
+  subscribers — ``fanout.relay.splices`` must rise at BOTH levels,
+  ``presence.lane.coalesced`` and ``session.readonly.connects`` must
+  rise at the core, and ``fanout.relay.encodes`` must stay 0 (zero
+  re-encode above the first gateway level).
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -203,6 +208,98 @@ def migration_gate() -> dict:
                 c.wait(timeout=10)
             except Exception:  # noqa: BLE001
                 c.kill()
+
+
+def relay_gate() -> dict:
+    """2-level relay tree, in process: core ← gw1 ← gw2 with read-only
+    binary subscribers on the leaf. Counter-asserts the tree's perf
+    contract: stamped frames SPLICE down every level
+    (``fanout.relay.splices`` nonzero at both), presence coalesces at
+    the core (``presence.lane.coalesced``), readers boot without quorum
+    membership (``session.readonly.connects``), and nothing re-encodes
+    above the first gateway level (``fanout.relay.encodes`` == 0)."""
+    import threading
+
+    from fluidframework_tpu.driver import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+    from fluidframework_tpu.service.gateway import Gateway
+
+    front = NetworkFrontEnd(LocalServer()).start_background()
+    containers = []
+    try:
+        gw1 = Gateway("127.0.0.1", front.port)
+        threading.Thread(target=gw1.serve_forever, daemon=True).start()
+        assert wait_for(lambda: gw1.port != 0), "relay gate: gw1 bind"
+        # the leaf's "core" IS gw1 — the --upstream-gateway topology
+        gw2 = Gateway("127.0.0.1", gw1.port)
+        threading.Thread(target=gw2.serve_forever, daemon=True).start()
+        assert wait_for(lambda: gw2.port != 0), "relay gate: gw2 bind"
+
+        writer = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", front.port)).resolve("smoke", "relaydoc")
+        containers.append(writer)
+        sstr = writer.runtime.create_data_store(
+            "default").create_channel("text", "shared-string")
+        sstr.insert_text(0, "seed ")
+        readers = []
+        for _ in range(3):
+            r = Loader(NetworkDocumentServiceFactory(
+                "127.0.0.1", gw2.port, readonly=True)).resolve(
+                "smoke", "relaydoc")
+            containers.append(r)
+            readers.append(r)
+
+        def rtext(r):
+            return (r.runtime.get_data_store("default")
+                    .get_channel("text").get_text())
+
+        for i in range(20):
+            sstr.insert_text(len(sstr.get_text()), f"w{i:02d} ")
+        want = sstr.get_text()
+        if not wait_for(lambda: all(rtext(r) == want for r in readers)):
+            raise AssertionError(
+                "relay gate: read-only leaf subscribers never converged "
+                f"({[len(rtext(r)) for r in readers]} vs {len(want)})")
+
+        # a cursor burst: coalesces ONCE at the core, splices down both
+        # levels, and the last write lands at the leaf
+        got = []
+        readers[0].on_signal = got.append
+        for i in range(40):
+            writer.submit_signal({"i": i}, type="cursor")
+        if not wait_for(lambda: any(s.content == {"i": 39} for s in got)):
+            raise AssertionError(
+                "relay gate: presence burst never reached the leaf "
+                f"({len(got)} signal(s) arrived)")
+
+        fsnap = front.counters.snapshot()
+        g1 = gw1.counters.snapshot()
+        g2 = gw2.counters.snapshot()
+        for level, snap in (("gw1", g1), ("gw2", g2)):
+            if snap.get("fanout.relay.encodes", 0):
+                raise AssertionError(
+                    f"relay gate: {level} re-encoded "
+                    f"{snap['fanout.relay.encodes']} frame(s) — the "
+                    "splice cache disengaged above the first level")
+        return {
+            # both levels must splice; min()==0 trips the dead check
+            "fanout.relay.splices": min(
+                g1.get("fanout.relay.splices", 0),
+                g2.get("fanout.relay.splices", 0)),
+            "fanout.upstream.frames": g2.get("fanout.upstream.frames", 0),
+            "presence.lane.coalesced": fsnap.get(
+                "presence.lane.coalesced", 0),
+            "session.readonly.connects": fsnap.get(
+                "session.readonly.connects", 0),
+        }
+    finally:
+        for c in containers:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        front.stop()
 
 
 def main() -> int:
@@ -510,6 +607,15 @@ def main() -> int:
     # process topology): zero lost acks, placement counters nonzero
     try:
         checks.update(migration_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+
+    # 2-level relay tree + read-only leaf subscribers (in-proc): splices
+    # nonzero at every level, presence coalesced at the core, and ZERO
+    # re-encodes above the first gateway level
+    try:
+        checks.update(relay_gate())
     except AssertionError as e:
         print(f"net_smoke: FAIL — {e}", file=sys.stderr)
         return 1
