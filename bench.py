@@ -1439,6 +1439,161 @@ def bench_migration_blip() -> dict:
             c.wait(timeout=10)
 
 
+def bench_net_rebalance_storm() -> dict:
+    """Armed-vs-disarmed A/B of the self-driving placement loop under a
+    hotspot storm (service/rebalancer.py).
+
+    Topology per arm: 4 partitions, 3 core processes — core 0 prefers
+    ALL partitions (the pathological placement), cores 1/2 join cold —
+    plus one gateway. Four writers ride the gateway with auto-reconnect,
+    one per partition, one of them viral. The armed arm runs every core
+    with ``--rebalance`` (0.25s tick, 2s dwell); the disarmed arm is the
+    identical topology with the loop off. Every probe op must ack
+    (pending drains to zero) in BOTH arms — op loss across an automatic
+    migration would fail the run, not just skew a percentile.
+
+    Published: head/tail windowed p99 of the viral writer per arm, the
+    fleet ``placement.rebalance.*`` counter deltas (``admin_placement
+    fleet=true``), end-of-run ownership spread, and per-core flap
+    counts. The armed arm must actually migrate (fleet
+    migrations_issued > 0), must not flap (0 re-moves inside dwell),
+    and ends with every core owning partitions; the disarmed arm is
+    the control that issued nothing."""
+    import os
+    import tempfile
+    import time as _time
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.stage_runner import doc_partition
+
+    n_shards = 4
+    storm_s = 10.0
+
+    def doc_for(k: int) -> str:
+        i = 0
+        while True:
+            d = f"rb{i}"
+            if doc_partition("bench", d, n_shards) == k:
+                return d
+            i += 1
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3) if vals else None
+
+    def run_arm(armed: bool) -> dict:
+        shard_dir = tempfile.mkdtemp(prefix="bench-rbstorm-")
+        cores, ports, gw = [], [], None
+        writers = []
+        try:
+            extra = (("--rebalance", "--rebalance-tick", "0.25",
+                      "--rebalance-dwell", "2.0", "--rebalance-budget",
+                      "1") if armed else ())
+            for i in range(3):
+                prefer = ("--prefer", "0,1,2,3") if i == 0 else ()
+                c, p = _spawn_listening(
+                    "fluidframework_tpu.service.front_end", "--port", "0",
+                    "--shard-dir", shard_dir, "--shards", str(n_shards),
+                    "--lease-ttl", "1.5", *prefer, *extra)
+                cores.append(c)
+                ports.append(p)
+            gw, gw_port = _spawn_listening(
+                "fluidframework_tpu.service.gateway", "--shard-dir",
+                shard_dir, "--shards", str(n_shards))
+            chans = []
+            for k in range(n_shards):
+                w = Loader(NetworkDocumentServiceFactory(
+                    "127.0.0.1", gw_port), auto_reconnect=True).resolve(
+                    "bench", doc_for(k))
+                writers.append(w)
+                chans.append(w.runtime.create_data_store(
+                    "default").create_channel("text", "shared-string"))
+
+            def acked_insert(w, ch) -> float:
+                t0 = _time.perf_counter()
+                ch.insert_text(0, "x")
+                deadline = _time.monotonic() + 30.0
+                while (w.runtime.pending.count
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.0005)
+                assert w.runtime.pending.count == 0, \
+                    "storm op never acked (lost across a rebalance flip)"
+                return (_time.perf_counter() - t0) * 1e3
+
+            samples = []  # (t_since_start, ack_ms) of the viral writer
+            t_start = _time.monotonic()
+            while _time.monotonic() - t_start < storm_s:
+                for _ in range(4):  # partition 0 is viral
+                    ms = acked_insert(writers[0], chans[0])
+                    samples.append((_time.monotonic() - t_start, ms))
+                for w, ch in zip(writers[1:], chans[1:]):
+                    acked_insert(w, ch)
+
+            head = [ms for t, ms in samples if t <= 2.5]
+            tail = [ms for t, ms in samples if t >= storm_s - 2.5]
+            placement = _admin_rpc(
+                ports[0], {"t": "admin_placement", "fleet": True}
+            )["placement"]
+            fleet = {k: v for k, v in placement["counters"].items()
+                     if k.startswith("placement.rebalance.")}
+            flaps, owning = 0, 0
+            for p in ports:
+                st = _admin_rpc(
+                    p, {"t": "admin_rebalance_status"})["rebalance"]
+                flaps += st.get("flaps", 0)
+                own = _admin_rpc(
+                    p, {"t": "admin_placement"})["placement"]["owned"]
+                owning += 1 if own else 0
+            return {
+                "armed": armed,
+                "hot_ops": len(samples),
+                "head_p99_ms": pct(head, 0.99),
+                "tail_p99_ms": pct(tail, 0.99),
+                "tail_p50_ms": pct(tail, 0.50),
+                "migrations_issued": fleet.get(
+                    "placement.rebalance.migrations_issued", 0),
+                "suppressed_hysteresis": fleet.get(
+                    "placement.rebalance.suppressed_hysteresis", 0),
+                "flaps": flaps,
+                "cores_owning": owning,
+            }
+        finally:
+            for w in writers:
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if gw is not None:
+                gw.terminate()
+            for c in cores:
+                c.terminate()
+            for c in cores:
+                c.wait(timeout=10)
+
+    armed = run_arm(True)
+    disarmed = run_arm(False)
+    assert armed["migrations_issued"] > 0, \
+        "armed storm issued no automatic migrations"
+    assert armed["flaps"] == 0, \
+        f"armed storm flapped ({armed['flaps']} re-moves inside dwell)"
+    assert disarmed["migrations_issued"] == 0, \
+        "disarmed control migrated — the A/B is not a control"
+    return {
+        "armed": armed,
+        "disarmed": disarmed,
+        # the loop's win: the viral writer's settled-window p99 once
+        # the hotspot has been spread, vs the same window with the one
+        # overloaded core still carrying everything. On a 1-CPU host
+        # the three core lanes time-slice and the contrast compresses.
+        "tail_p99_armed_vs_disarmed_ms": [
+            armed["tail_p99_ms"], disarmed["tail_p99_ms"]],
+        "host_limited": (os.cpu_count() or 1) < 4,
+    }
+
+
 def bench_multichip() -> dict:
     """Per-device scaling of the doc-mesh lane (tools/bench_multichip):
     docs axis 1→2→4→8 on forced host devices, in a FRESH process — XLA
@@ -1477,6 +1632,7 @@ def main() -> None:
     overload = bench_overload_sweep(net["knee"])
     join_storm = bench_join_storm()
     read_storm = bench_net_read_storm()
+    rebalance_storm = bench_net_rebalance_storm()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -1600,6 +1756,11 @@ def main() -> None:
                 # growth (~flat asserted), relay re-encodes
                 # counter-asserted 0 above the core
                 "net_read_storm": read_storm,
+                # self-driving placement A/B: the same 3-core hotspot
+                # storm with the rebalancer armed vs off. Armed must
+                # migrate (fleet counters), never flap, lose nothing,
+                # and end with every core owning partitions
+                "net_rebalance_storm": rebalance_storm,
                 # per-device scaling of the doc-mesh applier lane (docs
                 # axis 1→2→4→8, forced host devices; full artifact in
                 # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh

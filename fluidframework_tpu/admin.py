@@ -14,15 +14,25 @@ one CLI against the ordering core's admin frames (front_end.py
                                                [--count N]
     python -m fluidframework_tpu.admin metrics --port P
     python -m fluidframework_tpu.admin --port P slo
-    python -m fluidframework_tpu.admin placement --port P
+    python -m fluidframework_tpu.admin placement --port P [--fleet]
+    python -m fluidframework_tpu.admin placement heat --port P
+    python -m fluidframework_tpu.admin placement rebalance --port P
+    python -m fluidframework_tpu.admin placement drain CORE --port P
     python -m fluidframework_tpu.admin migrate TENANT DOC TARGET --port P
 
 ``placement`` prints the core's view of the routing plane: the epoch
-table (global epoch + per-partition owner/addr/epoch), the partitions
-this core serves, the lease liveness view, and the ``placement.*``
-counter snapshot. ``migrate`` triggers a live migration of the doc's
-partition to the core at TARGET (a ``host:port`` address as published
-in the epoch table) — point it at the CURRENT owner.
+table (global epoch + per-partition owner/addr/epoch), the core
+membership (active/draining/drained), the partitions this core serves,
+the lease liveness view, and the ``placement.*`` counter snapshot
+(``--fleet`` sums the counters across every reachable core).
+``placement heat`` fans out to every member and prints the windowed
+per-partition heat table the rebalancer plans from; ``placement
+rebalance`` shows the self-driving loop's status (last plan,
+suppression counts, flap count); ``placement drain CORE`` marks a
+member draining — the loop evacuates its partitions and flips it to
+drained for clean decommission. ``migrate`` triggers a live migration
+of the doc's partition to the core at TARGET (a ``host:port`` address
+as published in the epoch table) — point it at the CURRENT owner.
 
 ``slo`` prints one row per armed SLO spec — windowed p99 vs budget,
 state (ok/warn/violated), burn progress — plus whether SLO-burn
@@ -94,6 +104,104 @@ def _frame(args, frame: dict) -> dict:
     return frame
 
 
+def _peer_request(args, addr: str, frame: dict) -> dict:
+    """One admin RPC against a peer core at ``addr`` (host:port from the
+    epoch table's membership) — the CLI-side fan-out for `placement
+    heat`, sharing the deployment-wide admin secret."""
+    from .driver.network import _Transport
+
+    host, _, port = addr.rpartition(":")
+    t = _Transport(host or "127.0.0.1", int(port), timeout=10.0)
+    try:
+        return t.request(_frame(args, dict(frame)))
+    finally:
+        t.close()
+
+
+def _placement(args) -> int:
+    if args.action == "drain":
+        if not args.core:
+            print("drain requires a CORE owner id "
+                  "(see `admin placement` membership)")
+            return 1
+        reply = _request(args, {"t": "admin_placement_drain",
+                                "owner": args.core})
+        print(f"core {reply['owner']} marked draining: the rebalancer "
+              "evacuates its partitions, then flips it to drained")
+        return 0
+    if args.action == "rebalance":
+        frame = {"t": "admin_rebalance_status"}
+        if args.fleet:
+            frame["fleet"] = True
+        st = _request(args, frame)["rebalance"]
+        if not st.get("armed"):
+            print("rebalancer: disarmed (start the core with --rebalance)")
+            return 1
+        drain = (" DRAINED" if st.get("drained")
+                 else " draining" if st.get("draining") else "")
+        print(f"rebalancer: armed on {st['owner']}{drain}  "
+              f"tick {st['tick_s']}s dwell {st['dwell_s']}s "
+              f"budget {st['budget']} improvement {st['improvement']}")
+        print(f"  flaps {st['flaps']}  last_error {st['last_error']}")
+        plan = st.get("last_plan")
+        if plan is not None:
+            print(f"  last plan: {len(plan['moves'])} move(s)  "
+                  f"spread {plan['spread_before']} -> "
+                  f"{plan['spread_after']}  "
+                  f"suppressed hysteresis={plan['suppressed_hysteresis']} "
+                  f"budget={plan['suppressed_budget']}")
+            for m in plan["moves"]:
+                print(f"    part {m['k']}: {m['src']} -> {m['dst']} "
+                      f"(load {m['load']})")
+        for h in st.get("history", []):
+            print(f"  moved part {h['k']}: {h['src']} -> {h['dst']}")
+        for name, v in sorted(st.get("fleet_counters", {}).items()):
+            print(f"  {name} {v}")
+        return 0
+    frame = {"t": "admin_placement"}
+    if args.fleet:
+        frame["fleet"] = True
+    reply = _request(args, frame)
+    pl = reply.get("placement")
+    if pl is None:
+        print("not a sharded core (no placement plane)")
+        return 1
+    if args.action == "heat":
+        # per-core fan-out: every registered member answers for its own
+        # windowed series (heat lives in each core's process registry)
+        for owner, row in sorted(pl.get("cores", {}).items()):
+            try:
+                heat = _peer_request(args, row["addr"],
+                                     {"t": "admin_core_heat"})["heat"]
+            except (OSError, ValueError, RuntimeError) as e:
+                print(f"core {owner} @ {row['addr']} [{row['state']}] "
+                      f"unreachable: {e}")
+                continue
+            total = sum(h["ops"] for h in heat["parts"].values())
+            drain = " (draining)" if heat["draining"] else ""
+            print(f"core {owner} @ {row['addr']} [{row['state']}]"
+                  f"{drain}  total {total:.1f} ops/s "
+                  f"(window {heat['window_s']}s)")
+            for k in sorted(heat["parts"], key=int):
+                h = heat["parts"][k]
+                print(f"  part {k}: {h['ops']:.1f} ops/s  "
+                      f"{h['bytes']:.0f} B/s")
+        return 0
+    print(f"core {pl['owner']} @ {pl['address']}  "
+          f"epoch {pl['epoch']}  owns {pl['owned']}")
+    for owner, row in sorted(pl.get("cores", {}).items()):
+        print(f"  core {owner} @ {row['addr']} [{row['state']}]")
+    for k in sorted(pl["parts"], key=int):
+        part = pl["parts"][k]
+        print(f"  part {k}: {part['owner']} @ {part['addr']} "
+              f"(epoch {part['epoch']})")
+    for k, row in sorted(pl["leases"].items()):
+        print(f"  lease {k}: {row}")
+    for name, v in sorted(pl["counters"].items()):
+        print(f"  {name} {v}")
+    return 0
+
+
 def main(argv=None) -> int:
     # the connection options are accepted before OR after the
     # subcommand (`admin --port P slo` and `admin slo --port P` both
@@ -132,9 +240,22 @@ def main(argv=None) -> int:
     sub.add_parser("slo", parents=[common],
                    help="armed SLO specs: windowed p99 vs "
                         "budget, state, burn progress")
-    sub.add_parser("placement", parents=[common],
-                   help="routing plane: epoch table, owned partitions, "
-                        "leases, placement.* counters")
+    s = sub.add_parser("placement", parents=[common],
+                       help="routing plane: epoch table, membership, "
+                            "owned partitions, leases, placement.* "
+                            "counters; subviews: heat / rebalance / "
+                            "drain CORE")
+    s.add_argument("action", nargs="?", default=None,
+                   choices=["heat", "rebalance", "drain"],
+                   help="heat: per-core per-partition heat table; "
+                        "rebalance: loop status + last plan; "
+                        "drain: mark CORE draining (evacuate + "
+                        "decommission)")
+    s.add_argument("core", nargs="?", default=None,
+                   help="core owner id (drain only)")
+    s.add_argument("--fleet", action="store_true",
+                   help="sum placement counters across every reachable "
+                        "core instead of just the queried one")
     s = sub.add_parser("migrate", parents=[common],
                        help="live-migrate a doc's partition to another "
                             "core (point --port at the current owner)")
@@ -174,21 +295,7 @@ def main(argv=None) -> int:
         for d in reply["docs"]:
             print(d)
     elif args.cmd == "placement":
-        reply = _request(args, {"t": "admin_placement"})
-        pl = reply.get("placement")
-        if pl is None:
-            print("not a sharded core (no placement plane)")
-            return 1
-        print(f"core {pl['owner']} @ {pl['address']}  "
-              f"epoch {pl['epoch']}  owns {pl['owned']}")
-        for k in sorted(pl["parts"], key=int):
-            part = pl["parts"][k]
-            print(f"  part {k}: {part['owner']} @ {part['addr']} "
-                  f"(epoch {part['epoch']})")
-        for k, row in sorted(pl["leases"].items()):
-            print(f"  lease {k}: {row}")
-        for name, v in sorted(pl["counters"].items()):
-            print(f"  {name} {v}")
+        return _placement(args)
     elif args.cmd == "migrate":
         reply = _request(args, {"t": "admin_migrate_doc",
                                 "tenant": args.tenant, "doc": args.doc,

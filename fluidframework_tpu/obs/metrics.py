@@ -103,7 +103,7 @@ class WindowedSeries:
     the true count kept separately."""
 
     __slots__ = ("width", "buckets", "max_per_bucket", "_epochs",
-                 "_counts", "_samples", "_rng")
+                 "_counts", "_sums", "_samples", "_rng")
 
     def __init__(self, window_s: float = DEFAULT_WINDOW_S,
                  buckets: int = DEFAULT_WINDOW_BUCKETS,
@@ -113,6 +113,7 @@ class WindowedSeries:
         self.max_per_bucket = max_per_bucket
         self._epochs = [-1] * buckets
         self._counts = [0] * buckets
+        self._sums = [0.0] * buckets
         self._samples: list[list[float]] = [[] for _ in range(buckets)]
         self._rng = random.Random(0)
 
@@ -123,7 +124,9 @@ class WindowedSeries:
         if self._epochs[i] != e:
             self._epochs[i] = e
             self._counts[i] = 0
+            self._sums[i] = 0.0
             self._samples[i] = []
+        self._sums[i] += value
         n = self._counts[i] = self._counts[i] + 1
         s = self._samples[i]
         if len(s) < self.max_per_bucket:
@@ -150,6 +153,21 @@ class WindowedSeries:
                 count += self._counts[i]
                 merged.extend(self._samples[i])
         return count, merged
+
+    def sum(self, now: Optional[float] = None,
+            window_s: Optional[float] = None) -> float:
+        """EXACT sum of every value observed inside the window. The
+        quantile reads above ride a bounded reservoir, but each bucket
+        also keeps a running sum, so rate reads (the placement heat
+        planner's ops/s and bytes/s) never lose mass to sampling."""
+        now = time.monotonic() if now is None else now
+        e = int(now / self.width)
+        span = self.buckets
+        if window_s is not None:
+            span = max(1, min(span, math.ceil(window_s / self.width)))
+        lo = e - span + 1
+        return sum(self._sums[i] for i in range(self.buckets)
+                   if self._epochs[i] >= lo)
 
     def quantile(self, p: float, now: Optional[float] = None) -> float:
         _, merged = self.stats(now)
@@ -232,6 +250,38 @@ class MetricsRegistry:
             merged.extend(s)
         merged.sort()
         return count, {q: percentile(merged, q) for q in quantiles}
+
+    def window_sum(self, name: str, now: Optional[float] = None,
+                   window_s: Optional[float] = None, **labels) -> float:
+        """Exact windowed sum merged across every label set matching
+        the (subset) filter — the rate read behind the per-partition
+        heat signal (``window_stats`` answers "how slow", this answers
+        "how much")."""
+        want = [(k, str(v)) for k, v in labels.items()]
+        with self._lock:
+            table = self._windows.get(name, {})
+            matched = [ws for key, ws in table.items()
+                       if all(kv in key for kv in want)]
+        return sum(ws.sum(now, window_s) for ws in matched)
+
+    def window_sums_by(self, name: str, label: str,
+                       now: Optional[float] = None,
+                       window_s: Optional[float] = None
+                       ) -> dict[str, float]:
+        """``{label value: exact windowed sum}`` grouped over one label
+        key in a single registry pass — the whole per-partition heat
+        table (``label="part"``) without one lock round per
+        partition."""
+        with self._lock:
+            table = self._windows.get(name, {})
+            matched = [(dict(key).get(label), ws)
+                       for key, ws in table.items()]
+        out: dict[str, float] = {}
+        for lv, ws in matched:
+            if lv is None:
+                continue
+            out[lv] = out.get(lv, 0.0) + ws.sum(now, window_s)
+        return out
 
     def register_tier(self, tier: str, counters: Counters) -> None:
         """Track a tier's Counters weakly: the hot path keeps writing
@@ -376,6 +426,23 @@ def tier_snapshot(tier: str) -> dict:
     counts, _ = get_registry()._tier_snapshot()
     key = (("tier", tier),)
     return {name: v for (name, k), v in counts.items() if k == key}
+
+
+def sum_counter_snapshots(snaps) -> dict:
+    """Sum same-named counters across per-process snapshot dicts.
+
+    ``tier_snapshot`` covers exactly ONE process's registry; a sharded
+    deployment runs one core per OS process, so a fleet total (the
+    rebalancer's and the operator's view of ``placement.rebalance.*``)
+    must sum the per-core snapshots fetched over their admin doors
+    (``admin_tier_snapshot``). This is the pure summing half; the RPC
+    fan-out lives in service/rebalancer.py.
+    """
+    out: dict = {}
+    for snap in snaps:
+        for name, v in snap.items():
+            out[name] = out.get(name, 0) + v
+    return out
 
 
 def parse_prometheus(text: str) -> dict:

@@ -479,7 +479,9 @@ class _ClientSession:
                        "admin_slo_status", "admin_summarize",
                        "admin_tenant_add", "admin_tenant_remove",
                        "admin_placement", "admin_migrate_doc",
-                       "admin_adopt_partition"):
+                       "admin_adopt_partition", "admin_core_heat",
+                       "admin_tier_snapshot", "admin_rebalance_status",
+                       "admin_placement_drain", "admin_migrate_part"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -514,7 +516,8 @@ class _ClientSession:
                 _, ops, spans, blob, npool = binwire.decode_submit(
                     body, with_spans=True)
                 ops = self._filter_oversized(ops, len(body), None)
-                ops = self._admit_or_shed(self.conn, ops, None)
+                ops = self._admit_or_shed(self.conn, ops, None,
+                                          nbytes=len(body))
                 if ops:
                     _stamp_admit(ops)
                     # expose the splice context for the SYNCHRONOUS
@@ -531,7 +534,8 @@ class _ClientSession:
                     body, with_spans=True)
                 conn = self._fsessions[sid]
                 ops = self._filter_oversized(ops, len(body), sid)
-                ops = self._admit_or_shed(conn, ops, sid)
+                ops = self._admit_or_shed(conn, ops, sid,
+                                          nbytes=len(body))
                 if ops:
                     _stamp_admit(ops)
                     self.front._splice_ctx = (spans, blob, npool)
@@ -583,12 +587,15 @@ class _ClientSession:
                 kept.append(op)
         return kept
 
-    def _admit_or_shed(self, conn, ops: list, sid) -> list:
+    def _admit_or_shed(self, conn, ops: list, sid,
+                       nbytes: int = 0) -> list:
         """THE admission gate: every rec-lane submit door passes its
         ops through here after the size filter (the columnar door runs
         the same check on its packed columns in ``_submit_columns``).
         Also the per-tenant ingress accounting point — one labeled
-        registry inc per boxcar, never per op."""
+        registry inc per boxcar, never per op — and the per-partition
+        heat recording point the rebalancer plans from (admitted ops
+        only: shed traffic is load the partition did NOT carry)."""
         if not ops:
             return ops
         get_registry().inc("net.ingress.ops", len(ops),
@@ -605,14 +612,14 @@ class _ClientSession:
                 message="partition migrating: resubmit shortly")
             return []
         adm = self.front.admission
-        if adm is None:
-            return ops
-        retry_s = adm.check(conn, len(ops),
-                            ops[0].client_sequence_number)
-        if retry_s <= 0.0:
-            return ops
-        self._push_shed_nacks(ops, retry_s, sid)
-        return []
+        if adm is not None:
+            retry_s = adm.check(conn, len(ops),
+                                ops[0].client_sequence_number)
+            if retry_s > 0.0:
+                self._push_shed_nacks(ops, retry_s, sid)
+                return []
+        self.front.record_heat(conn.server, len(ops), nbytes)
+        return ops
 
     def _push_shed_nacks(self, ops: list, retry_s: float, sid,
                          message: str = "tenant over admission "
@@ -682,6 +689,7 @@ class _ClientSession:
                     self._push_shed_nacks(binwire.cols_to_ops(sc),
                                           retry_s, sid)
                     return
+            front.record_heat(conn.server, n, len(body))
         limit = front.max_message_size
         if (getattr(conn, "can_write", True)
                 and 6 * len(body) + 512 <= limit):
@@ -1113,16 +1121,25 @@ class _ClientSession:
             rec = sh.table.read()
             from ..obs import tier_snapshot
 
-            snap = tier_snapshot("placement")
+            if frame.get("fleet"):
+                # fleet totals: this core's snapshot summed with every
+                # reachable peer's (admin_tier_snapshot fan-out) — the
+                # operator sees migrations the WHOLE loop issued, not
+                # just the local lane's
+                counters = front._fleet_placement_counters(rec)
+            else:
+                snap = tier_snapshot("placement")
+                counters = {name: v for name, v in snap.items()
+                            if name.startswith("placement.")}
             self.push("admin", {"rid": rid, "placement": {
                 "owner": sh.owner_id,
                 "address": sh.address,
                 "epoch": rec["epoch"],
                 "parts": rec["parts"],
+                "cores": rec.get("cores", {}),
                 "owned": sorted(sh.servers),
                 "leases": sh.placement.table(),
-                "counters": {name: v for name, v in snap.items()
-                             if name.startswith("placement.")},
+                "counters": counters,
             }})
         elif t == "admin_migrate_doc":
             # live migration trigger: move the doc's PARTITION to the
@@ -1149,6 +1166,74 @@ class _ClientSession:
                 raise ValueError("not a sharded core")
             result = front.migration_engine.adopt(
                 int(frame["k"]), frame["from_owner"])
+            self.push("admin", {"rid": rid, **result})
+        elif t == "admin_core_heat":
+            # read-only: this core's windowed per-partition heat — the
+            # rebalancer's fleet scrape AND the `admin placement heat`
+            # table both read this; a failed dial here marks the core
+            # unreachable (never a migration target)
+            sh = front.shard_host
+            if sh is None:
+                self.push("admin", {"rid": rid, "heat": None})
+                return
+            from .rebalancer import HEAT_WINDOW_S, read_local_heat
+
+            heat = read_local_heat(list(sh.servers))
+            self.push("admin", {"rid": rid, "heat": {
+                "owner": sh.owner_id,
+                "addr": sh.address,
+                "draining": bool(getattr(sh, "draining", False)),
+                "window_s": HEAT_WINDOW_S,
+                "parts": {str(k): {"ops": round(h.ops, 3),
+                                   "bytes": round(h.bytes, 3)}
+                          for k, h in sorted(heat.items())},
+            }})
+        elif t == "admin_tier_snapshot":
+            # read-only: one tier's per-process counter sums — the
+            # fleet-aggregation building block (obs.sum_counter_snapshots
+            # over every core's reply = fleet totals)
+            from ..obs import tier_snapshot
+
+            self.push("admin", {
+                "rid": rid,
+                "counters": tier_snapshot(str(frame["tier"]))})
+        elif t == "admin_rebalance_status":
+            # read-only: the loop's own account of itself (armed, last
+            # plan, suppressions, flap count) + optional fleet counters
+            reb = front.rebalancer
+            status = (reb.status() if reb is not None
+                      else {"armed": False})
+            if frame.get("fleet") and front.shard_host is not None:
+                status["fleet_counters"] = front._fleet_placement_counters(
+                    front.shard_host.table.read())
+            self.push("admin", {"rid": rid, "rebalance": status})
+        elif t == "admin_placement_drain":
+            # mark a member draining: every rebalancer tick on that core
+            # now evacuates its partitions (dwell/threshold exempt) until
+            # it owns nothing and flips itself to drained
+            sh = front.shard_host
+            if sh is None:
+                raise ValueError("not a sharded core")
+            from .placement_plane import CORE_DRAINING
+
+            ok = sh.table.set_core_state(frame["owner"], CORE_DRAINING)
+            if not ok:
+                raise ValueError(
+                    f"unknown core {frame['owner']!r} (not registered)")
+            self.push("admin", {"rid": rid, "ok": True,
+                                "owner": frame["owner"]})
+        elif t == "admin_migrate_part":
+            # partition-addressed migration trigger (admin_migrate_doc's
+            # sibling): the rebalancer daemon actuates through a loopback
+            # RPC to THIS handler so the seal→fence→handoff runs on the
+            # event loop — same single-threaded no-two-writers proof as
+            # the operator door
+            sh = front.shard_host
+            if sh is None:
+                raise ValueError("not a sharded core")
+            result = front.migration_engine.migrate(
+                int(frame["k"]), frame["target"],
+                on_flip=front._on_migration_flip)
             self.push("admin", {"rid": rid, **result})
 
     def _unsubscribe_ftopic(self, topic: str) -> None:
@@ -1273,6 +1358,10 @@ class ShardHost:
         # the front end closes the partition's live sessions so clients
         # reconnect to the takeover owner
         self.on_drop = None
+        # elastic membership: set from the epoch table's cores section
+        # each poll — a draining host claims nothing (the rebalancer
+        # evacuates what it still owns)
+        self.draining = False
 
     def _make_server(self, k: int) -> LocalServer:
         import os
@@ -1298,6 +1387,9 @@ class ShardHost:
                          if (self.table_epochs.get(k, 0)
                              > self.claim_epochs.get(k, 0))
                          else None))
+        # which partition this server sequences — the front end's heat
+        # recording labels the windowed series with it
+        server.part_k = k
         return server
 
     def _reload_tenants(self) -> None:
@@ -1340,6 +1432,15 @@ class ShardHost:
         # refresh the epoch-fence view (one mtime-cached file read);
         # writes are flock-ordered, so this can only move forward
         self.table_epochs = self.table.part_epochs()
+        if self.address:
+            # membership: advertise this core (no-op when unchanged) and
+            # pick up an operator drain mark — a draining host stops
+            # claiming; the rebalancer evacuates what it still owns
+            self.table.record_core(self.owner_id, self.address)
+            from .placement_plane import CORE_DRAINED, CORE_DRAINING
+
+            self.draining = self.table.core_state(self.owner_id) in (
+                CORE_DRAINING, CORE_DRAINED)
         if self._start_t is None:
             self._start_t = time.monotonic()
         for k in list(self.servers):
@@ -1361,6 +1462,8 @@ class ShardHost:
                     self.on_drop(k, server)
         in_grace = (time.monotonic() - self._start_t
                     < self.placement.ttl_s + 0.5)
+        if self.draining:
+            return  # evacuating: never claim, not even takeovers
         for k in range(self.n):
             if k in self.servers or k in self.migrating:
                 continue
@@ -1466,6 +1569,11 @@ class NetworkFrontEnd:
         # SLO engine is attached
         self.admission: Optional[AdmissionController] = None
         self.slo_engine = None
+        # self-driving placement: armed by --rebalance (enable_rebalancer
+        # stores the config; _start constructs the daemon once the port
+        # is bound and the shard host registered in the epoch table)
+        self.rebalancer = None
+        self._rebalance_cfg: Optional[dict] = None
         # live _ClientSessions (lease-loss teardown walks these)
         self._sessions: set = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -1492,6 +1600,61 @@ class NetworkFrontEnd:
         adm.engine = engine
         adm.shedding = shedding
         return self
+
+    def record_heat(self, server, n_ops: int, n_bytes: int) -> None:
+        """Per-partition load accounting (the rebalancer's input): one
+        windowed observe per admitted boxcar, labeled with the serving
+        partition. Single-pipeline deployments have no ``part_k`` and
+        record nothing — there is nowhere to rebalance to."""
+        k = getattr(server, "part_k", None)
+        if k is None:
+            return
+        from .rebalancer import HEAT_BYTES, HEAT_OPS
+
+        reg = get_registry()
+        reg.observe_windowed(HEAT_OPS, float(n_ops), part=str(k))
+        if n_bytes:
+            reg.observe_windowed(HEAT_BYTES, float(n_bytes), part=str(k))
+
+    def enable_rebalancer(self, tick_s: float = 0.5,
+                          dwell_s: float = 10.0, budget: int = 2,
+                          improvement: float = 0.25) -> "NetworkFrontEnd":
+        """Arm the self-driving placement loop (--rebalance). Stored as
+        config here; the daemon itself starts in ``_start`` once the
+        bound address exists (migration targets need it)."""
+        if self.shard_host is None:
+            raise ValueError("--rebalance requires a sharded core")
+        self._rebalance_cfg = {
+            "tick_s": tick_s, "dwell_s": dwell_s,
+            "budget": budget, "improvement": improvement}
+        return self
+
+    def _rebalance_actuate(self, k: int, target_addr: str) -> None:
+        """Actuation seam for the rebalancer's ticker THREAD: a loopback
+        ``admin_migrate_part`` RPC against our own event loop, so the
+        seal→fence→handoff runs exactly where the operator door runs it
+        (single-threaded, no submit frame can interleave)."""
+        from .placement_plane import admin_rpc
+
+        frame = {"t": "admin_migrate_part", "k": k, "target": target_addr}
+        if self.admin_secret:
+            frame["secret"] = self.admin_secret
+        admin_rpc(self.host, self.port, frame)
+
+    def _fleet_placement_counters(self, table_rec: dict) -> dict:
+        """Fleet-total placement counters: this process's snapshot summed
+        with every reachable peer core's (``admin_tier_snapshot``)."""
+        from ..obs import sum_counter_snapshots, tier_snapshot
+        from .rebalancer import peer_tier_snapshots
+
+        snaps = [tier_snapshot("placement")]
+        if self.shard_host is not None:
+            snaps.extend(peer_tier_snapshots(
+                table_rec, self.shard_host.owner_id, "placement",
+                secret=self.admin_secret))
+        total = sum_counter_snapshots(snaps)
+        return {name: v for name, v in total.items()
+                if name.startswith("placement.")}
 
     def server_for(self, tenant: str, doc: str) -> LocalServer:
         """The LocalServer serving this doc: the single pipeline, or the
@@ -1785,6 +1948,18 @@ class NetworkFrontEnd:
             self.shard_host.on_drop = on_drop
             self.shard_host.address = f"{self.host}:{self.port}"
             self.shard_host.poll()  # claim preferred partitions NOW
+            if self._rebalance_cfg is not None:
+                # armed after the first poll: the bound address is in the
+                # epoch table's membership, so peers can target us; the
+                # ticker thread actuates via loopback admin RPCs
+                from .rebalancer import Rebalancer
+
+                self.rebalancer = Rebalancer(
+                    self.shard_host, self.migration_engine,
+                    slo_engine=self.slo_engine,
+                    actuate=self._rebalance_actuate,
+                    secret=self.admin_secret,
+                    **self._rebalance_cfg).start()
 
             async def lease_loop():
                 interval = self.shard_host.placement.ttl_s / 3.0
@@ -1820,6 +1995,9 @@ class NetworkFrontEnd:
         return self
 
     def stop(self) -> None:
+        if self.rebalancer is not None:
+            self.rebalancer.stop()
+            self.rebalancer = None
         if self._loop is not None:
             loop = self._loop
 
@@ -1977,7 +2155,26 @@ def main() -> None:
                         help="run the service summarizer loop: a new "
                              "columnar snapshot every N sequenced ops "
                              "per doc (the snapshot fast-boot plane)")
+    # self-driving placement (service/rebalancer.py): close the
+    # load→decision→migration loop on this core
+    parser.add_argument("--rebalance", action="store_true",
+                        help="arm the placement rebalancer daemon "
+                             "(requires --shard-dir)")
+    parser.add_argument("--rebalance-tick", type=float, default=0.5,
+                        metavar="S", help="planner tick interval")
+    parser.add_argument("--rebalance-dwell", type=float, default=10.0,
+                        metavar="S", help="per-partition minimum dwell "
+                                          "between moves")
+    parser.add_argument("--rebalance-budget", type=int, default=2,
+                        metavar="N", help="max migrations per tick from "
+                                          "this core")
+    parser.add_argument("--rebalance-improvement", type=float,
+                        default=0.25, metavar="F",
+                        help="min hottest→coldest gap as a fraction of "
+                             "mean load before a move is worth it")
     args = parser.parse_args()
+    if args.rebalance and args.shard_dir is None:
+        parser.error("--rebalance requires --shard-dir")
     if args.shard_dir is not None:
         import gc as _gc
 
@@ -2008,6 +2205,12 @@ def main() -> None:
         _apply_overload_flags(front, args, parser)
         if args.summarize_every is not None:
             front.enable_summarizer(args.summarize_every)
+        if args.rebalance:
+            front.enable_rebalancer(
+                tick_s=args.rebalance_tick,
+                dwell_s=args.rebalance_dwell,
+                budget=args.rebalance_budget,
+                improvement=args.rebalance_improvement)
         front.serve_forever()
         return
     server = None

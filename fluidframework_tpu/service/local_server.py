@@ -237,6 +237,11 @@ class LocalServer:
         # epoch fence (deli admission): a callable returning the CURRENT
         # table epoch when this server's claim epoch is stale, else None
         self.epoch_fence = None
+        # which doc partition this server sequences (sharded cores only;
+        # ShardHost._make_server stamps it) — the front end labels the
+        # rebalancer's windowed heat series with it, and None means
+        # single-pipeline: no heat accounting, nowhere to rebalance
+        self.part_k = None
 
     def seal(self) -> None:
         """Migration fence point: refuse new submits (they bounce with a

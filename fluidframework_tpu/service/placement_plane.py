@@ -48,6 +48,15 @@ from .placement import PlacementDir
 #: subdirectory of the shard dir holding the routing table
 TABLE_DIRNAME = "placement"
 
+#: core membership states in the table's ``cores`` section (elastic
+#: membership, ref: consumer-group join/leave): ``active`` serves and
+#: may receive rebalanced partitions, ``draining`` is being emptied by
+#: live migration (``admin placement drain``), ``drained`` owns nothing
+#: and is safe to decommission.
+CORE_ACTIVE = "active"
+CORE_DRAINING = "draining"
+CORE_DRAINED = "drained"
+
 _SHARED_COUNTERS = None
 
 
@@ -139,6 +148,17 @@ class EpochTable:
         return {int(k): p["epoch"]
                 for k, p in self.read()["parts"].items()}
 
+    def cores(self) -> dict:
+        """Core membership: ``{owner: {"addr", "state"}}``. Registration
+        is the ShardHost's per-poll ``record_core``; the rebalancer reads
+        this to know which cores exist (cold joiners included — a fresh
+        member owns nothing, so ``parts`` alone can't see it)."""
+        return self.read().get("cores", {})
+
+    def core_state(self, owner: str) -> Optional[str]:
+        row = self.cores().get(owner)
+        return row["state"] if row else None
+
     # ------------------------------------------------------------- writers
 
     def _write(self, rec: dict) -> None:
@@ -174,6 +194,47 @@ class EpochTable:
             self._write(rec)
         self.counters.inc("placement.epoch.bumps")
         return rec["epoch"]
+
+    def record_core(self, owner: str, addr: str) -> None:
+        """Register ``owner@addr`` as a member (ShardHost calls this once
+        per poll — cheap no-op when the row already matches). Membership
+        is a capacity advertisement, not a route: nothing fences on it,
+        so it does NOT bump the epoch. An existing draining/drained mark
+        survives re-registration — the drain decision outlives the
+        core's own heartbeat."""
+        row = self.cores().get(owner)
+        if row is not None and row["addr"] == addr:
+            return
+        with _flock(self._lock_path):
+            rec = self._read_fresh()
+            cores = rec.setdefault("cores", {})
+            prev = cores.get(owner)
+            cores[owner] = {
+                "addr": addr,
+                "state": prev["state"] if prev else CORE_ACTIVE}
+            self._write(rec)
+
+    def set_core_state(self, owner: str, state: str) -> bool:
+        """Flip a member's state (``admin placement drain``, or the
+        rebalancer marking a drained core). False for unknown owners —
+        draining a core that never registered is an operator typo, not
+        a pending instruction."""
+        with _flock(self._lock_path):
+            rec = self._read_fresh()
+            row = rec.get("cores", {}).get(owner)
+            if row is None:
+                return False
+            if row["state"] != state:
+                row["state"] = state
+                self._write(rec)
+        return True
+
+    def remove_core(self, owner: str) -> None:
+        """Forget a decommissioned member entirely."""
+        with _flock(self._lock_path):
+            rec = self._read_fresh()
+            if rec.get("cores", {}).pop(owner, None) is not None:
+                self._write(rec)
 
 
 class RoutingCache:

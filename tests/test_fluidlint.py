@@ -378,6 +378,32 @@ def test_placement_family_lock_caught(tmp_path):
     assert "placement.migration.committed" in vs[0].message
 
 
+def test_rebalance_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('placement.rebalance.migrations')\n")  # not a member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "placement.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "placement.rebalance.migrations_issued" in vs[0].message
+
+
+def test_heat_family_members_pass(tmp_path):
+    # the rebalancer's locked heat/decision names are legal as written
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('placement.heat.ops')\n"
+        "    c.inc('placement.heat.bytes')\n"
+        "    c.inc('placement.rebalance.ticks')\n"
+        "    c.inc('placement.rebalance.plans')\n"
+        "    c.inc('placement.rebalance.suppressed_hysteresis')\n"
+        "    c.inc('placement.rebalance.suppressed_budget')\n")
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert vs == [], [str(v) for v in vs]
+
+
 def test_applier_family_lock_caught(tmp_path):
     path = _metrics_file(
         tmp_path,

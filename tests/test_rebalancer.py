@@ -1,0 +1,349 @@
+"""Self-driving placement: the pure bin-packing planner (determinism,
+hysteresis math under a frozen clock, drain/join semantics), the epoch
+table's elastic membership section, the windowed heat plumbing, and the
+Rebalancer daemon's tick loop over real in-proc migrations.
+
+Ref: lambdas-driver/kafka-service/partitionManager.ts is the reference's
+consumer-group rebalance analog; the planner and its hysteresis gates
+are ours (service/rebalancer.py, ARCHITECTURE.md "Self-driving
+placement").
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.obs import (
+    get_registry,
+    reset_registry,
+    sum_counter_snapshots,
+)
+from fluidframework_tpu.service.front_end import ShardHost
+from fluidframework_tpu.service.placement_plane import (
+    CORE_ACTIVE,
+    CORE_DRAINED,
+    CORE_DRAINING,
+    EpochTable,
+    MigrationEngine,
+)
+from fluidframework_tpu.service.rebalancer import (
+    HEAT_OPS,
+    PartHeat,
+    Rebalancer,
+    plan_rebalance,
+    read_local_heat,
+)
+from fluidframework_tpu.utils.telemetry import Counters
+
+
+def _cores(*owners, draining=()):
+    return {o: {"addr": f"addr-{o}",
+                "state": CORE_DRAINING if o in draining else CORE_ACTIVE}
+            for o in owners}
+
+
+def _plan(heat, owners, cores, last_moved=None, now=100.0, **kw):
+    kw.setdefault("dwell_s", 10.0)
+    kw.setdefault("budget", 2)
+    kw.setdefault("improvement", 0.25)
+    return plan_rebalance(heat, owners, cores, last_moved or {}, now, **kw)
+
+
+# ------------------------------------------------------------- planner
+
+
+def test_balanced_load_is_a_noop():
+    heat = {k: PartHeat(ops=10.0) for k in range(4)}
+    owners = {0: "a", 1: "a", 2: "b", 3: "b"}
+    plan = _plan(heat, owners, _cores("a", "b"))
+    assert plan.moves == ()
+    assert plan.suppressed_hysteresis == 0
+    assert plan.spread_before == plan.spread_after == 0.0
+
+
+def test_hotspot_moves_to_coldest_core():
+    heat = {0: PartHeat(ops=90.0), 1: PartHeat(ops=10.0),
+            2: PartHeat(ops=10.0), 3: PartHeat(ops=10.0)}
+    owners = {k: "a" for k in range(4)}
+    plan = _plan(heat, owners, _cores("a", "b", "c"))
+    assert plan.moves
+    assert all(m.src == "a" for m in plan.moves)
+    assert plan.spread_after < plan.spread_before
+    # the viral part goes to A core, not back and forth
+    dsts = {m.dst for m in plan.moves}
+    assert dsts <= {"b", "c"}
+
+
+def test_deterministic_under_permuted_input():
+    rng = random.Random(7)
+    heat = {k: PartHeat(ops=float(rng.randrange(1, 100)),
+                        bytes=float(rng.randrange(0, 4096)))
+            for k in range(16)}
+    owners = {k: "abc"[k % 3] for k in range(16)}
+    cores = _cores("a", "b", "c", "d")
+    last_moved = {3: 95.0, 7: 10.0}
+    baseline = _plan(heat, owners, cores, last_moved)
+    for seed in range(8):
+        r = random.Random(seed)
+
+        def shuffled(d):
+            items = list(d.items())
+            r.shuffle(items)
+            return dict(items)
+
+        plan = _plan(shuffled(heat), shuffled(owners), shuffled(cores),
+                     shuffled(last_moved))
+        assert plan == baseline
+
+
+def test_dwell_suppresses_then_releases_frozen_clock():
+    heat = {0: PartHeat(ops=60.0), 1: PartHeat(ops=30.0)}
+    owners = {0: "a", 1: "a"}
+    cores = _cores("a", "b")
+    # both parts moved at t=95; at t=100 their 10 s dwell still holds
+    held = _plan(heat, owners, cores,
+                 last_moved={0: 95.0, 1: 95.0}, now=100.0)
+    assert held.moves == ()
+    assert held.suppressed_hysteresis == 2
+    # the same input 10 s later: dwell expired, the move is planned
+    released = _plan(heat, owners, cores,
+                     last_moved={0: 95.0, 1: 95.0}, now=105.1)
+    assert [m.k for m in released.moves] == [0]
+    assert released.suppressed_hysteresis == 0
+
+
+def test_budget_caps_moves_and_counts_the_overflow():
+    heat = {k: PartHeat(ops=50.0) for k in range(6)}
+    owners = {k: "a" for k in range(6)}
+    plan = _plan(heat, owners, _cores("a", "b"), budget=1)
+    assert len(plan.moves) == 1
+    assert plan.suppressed_budget == 1
+    # with room, the same input plans more moves
+    assert len(_plan(heat, owners, _cores("a", "b"), budget=3).moves) > 1
+
+
+def test_improvement_threshold_and_slo_urgency():
+    # gap of ~30% of mean: under a 50% threshold nothing moves...
+    heat = {0: PartHeat(ops=40.0), 1: PartHeat(ops=5.0),
+            2: PartHeat(ops=30.0), 3: PartHeat(ops=3.0)}
+    owners = {0: "a", 1: "a", 2: "b", 3: "b"}
+    cores = _cores("a", "b")
+    calm = _plan(heat, owners, cores, improvement=0.5)
+    assert calm.moves == ()
+    # ...but an SLO burn halves the threshold and the move happens
+    hot = _plan(heat, owners, cores, improvement=0.5, slo_hot=True)
+    assert [m.k for m in hot.moves] == [1]
+
+
+def test_join_absorbs_load_onto_cold_core():
+    heat = {k: PartHeat(ops=20.0) for k in range(4)}
+    owners = {0: "a", 1: "a", 2: "b", 3: "b"}
+    # core c just registered: owns nothing, maximally cold
+    plan = _plan(heat, owners, _cores("a", "b", "c"))
+    assert plan.moves
+    assert all(m.dst == "c" for m in plan.moves)
+    assert plan.spread_after < plan.spread_before
+
+
+def test_drain_empties_core_ignoring_dwell_and_threshold():
+    # cold partitions and freshly-moved partitions still evacuate
+    heat = {0: PartHeat(ops=0.0), 1: PartHeat(ops=1.0)}
+    owners = {0: "b", 1: "b"}
+    cores = _cores("a", "b", draining=("b",))
+    plan = _plan(heat, owners, cores, last_moved={0: 99.9, 1: 99.9},
+                 now=100.0, budget=4)
+    assert sorted(m.k for m in plan.moves) == [0, 1]
+    assert all(m.src == "b" and m.dst == "a" for m in plan.moves)
+    # hottest part leaves first
+    assert plan.moves[0].k == 1
+
+
+def test_only_source_restricts_and_unlisted_cores_untouched():
+    heat = {0: PartHeat(ops=90.0), 1: PartHeat(ops=90.0),
+            2: PartHeat(ops=1.0)}
+    owners = {0: "a", 1: "ghost", 2: "b"}
+    cores = _cores("a", "b")  # "ghost" unreachable / unregistered
+    plan = _plan(heat, owners, cores, only_source="b")
+    assert plan.moves == ()  # b is the coldest; nothing to give
+    plan = _plan(heat, owners, cores, only_source="a")
+    assert all(m.src == "a" for m in plan.moves)
+    assert all(m.k != 1 for m in plan.moves)  # ghost's part never planned
+
+
+def test_no_move_that_overshoots_the_gap():
+    # moving the only hot part would just swap the imbalance: refuse
+    heat = {0: PartHeat(ops=100.0)}
+    owners = {0: "a"}
+    plan = _plan(heat, owners, _cores("a", "b"))
+    assert plan.moves == ()
+
+
+# --------------------------------------------------- elastic membership
+
+
+def test_core_membership_records_without_epoch_bumps(tmp_path):
+    table = EpochTable(str(tmp_path / "placement"),
+                       counters=Counters("placement"))
+    e0 = table.global_epoch()
+    table.record_core("a", "addr-a")
+    table.record_core("b", "addr-b")
+    assert table.global_epoch() == e0  # membership never fences
+    assert set(table.cores()) == {"a", "b"}
+    assert table.core_state("a") == CORE_ACTIVE
+    # drain survives re-registration (the host's poll keeps advertising)
+    assert table.set_core_state("a", CORE_DRAINING)
+    table.record_core("a", "addr-a2")
+    assert table.core_state("a") == CORE_DRAINING
+    assert table.cores()["a"]["addr"] == "addr-a2"
+    # unknown owner is a refusal, not a silent pending mark
+    assert not table.set_core_state("nobody", CORE_DRAINING)
+    table.remove_core("a")
+    assert table.core_state("a") is None
+
+
+def test_draining_host_stops_claiming(tmp_path):
+    host = ShardHost(str(tmp_path), 2, prefer=(0, 1), ttl_s=30.0)
+    host.address = f"inproc/{host.owner_id}"
+    host.poll()
+    assert sorted(host.servers) == [0, 1]
+    assert host.table.core_state(host.owner_id) == CORE_ACTIVE
+    host.table.set_core_state(host.owner_id, CORE_DRAINING)
+    host.poll()
+    assert host.draining
+    # release everything; a draining host must not re-claim
+    host.release_all()
+    host.poll()
+    assert host.servers == {}
+    for s in ():
+        s.log.close()
+
+
+# ----------------------------------------------------------- heat read
+
+
+def test_windowed_heat_read_is_exact(monkeypatch):
+    reset_registry()
+    try:
+        reg = get_registry()
+        for _ in range(50):
+            reg.observe_windowed(HEAT_OPS, 2.0, now=1000.0, part="0")
+        reg.observe_windowed(HEAT_OPS, 7.0, now=1000.0, part="1")
+        heat = read_local_heat([0, 1, 2], now=1000.0, registry=reg)
+        # exact sums (no reservoir sampling loss), folded to rates
+        assert heat[0].ops == pytest.approx(100.0 / 10.0)
+        assert heat[1].ops == pytest.approx(7.0 / 10.0)
+        assert heat[2].ops == 0.0  # owned-but-cold still present
+    finally:
+        reset_registry()
+
+
+def test_sum_counter_snapshots_fleet_totals():
+    total = sum_counter_snapshots([
+        {"placement.rebalance.ticks": 5,
+         "placement.rebalance.migrations_issued": 1},
+        {"placement.rebalance.ticks": 7},
+        {},
+    ])
+    assert total == {"placement.rebalance.ticks": 12,
+                     "placement.rebalance.migrations_issued": 1}
+
+
+# ------------------------------------------------------- daemon ticks
+
+
+def _two_hosts(tmp_path, n=2):
+    a = ShardHost(str(tmp_path), n, prefer=range(n), ttl_s=30.0)
+    a.address = f"inproc/{a.owner_id}"
+    a.poll()
+    b = ShardHost(str(tmp_path), n, ttl_s=30.0)
+    b.address = f"inproc/{b.owner_id}"
+    b.poll()
+    return a, b
+
+
+def _rebalancer_for(src, tgt, heat_by_part, pc, **kw):
+    eng_src = MigrationEngine(src, counters=pc)
+    eng_tgt = MigrationEngine(tgt, counters=pc)
+
+    def heat_reader(owners, cores, now):
+        heat = {k: heat_by_part.get(k, PartHeat()) for k in owners}
+        return heat, set(cores)
+
+    def actuate(k, target_addr):
+        eng_src.migrate(
+            k, target_addr,
+            adopt=lambda k, addr: eng_tgt.adopt(k, src.owner_id))
+
+    kw.setdefault("dwell_s", 10.0)
+    kw.setdefault("budget", 1)
+    kw.setdefault("improvement", 0.25)
+    return Rebalancer(src, eng_src, heat_reader=heat_reader,
+                      actuate=actuate, counters=pc, **kw)
+
+
+def test_tick_migrates_hot_partition_for_real(tmp_path):
+    pc = Counters("placement")
+    a, b = _two_hosts(tmp_path)
+    reb = _rebalancer_for(a, b, {0: PartHeat(ops=90.0),
+                                 1: PartHeat(ops=10.0)}, pc)
+    plan = reb.tick(now=100.0)
+    assert [m.k for m in plan.moves] == [0]
+    assert 0 in b.servers and 0 not in a.servers
+    assert pc.snapshot()["placement.rebalance.migrations_issued"] == 1
+    assert pc.snapshot()["placement.rebalance.ticks"] == 1
+    # the next tick sees the move it just made: dwell holds part 0
+    plan2 = reb.tick(now=101.0)
+    assert plan2.moves == ()
+    assert reb.flap_count() == 0
+    for h in (a, b):
+        for s in h.servers.values():
+            s.log.close()
+
+
+def test_tick_drains_and_marks_drained(tmp_path):
+    pc = Counters("placement")
+    a, b = _two_hosts(tmp_path)
+    a.table.set_core_state(a.owner_id, CORE_DRAINING)
+    a.poll()
+    assert a.draining
+    reb = _rebalancer_for(a, b, {0: PartHeat(ops=5.0),
+                                 1: PartHeat(ops=5.0)}, pc, budget=2)
+    reb.tick(now=100.0)
+    assert a.servers == {}
+    assert sorted(b.servers) == [0, 1]
+    reb.tick(now=101.0)  # the empty tick flips the membership state
+    assert a.table.core_state(a.owner_id) == CORE_DRAINED
+    st = reb.status()
+    assert st["draining"] and st["drained"]
+    for s in b.servers.values():
+        s.log.close()
+
+
+def test_dwell_clock_follows_peer_epoch_bumps(tmp_path):
+    """A move issued by ANOTHER core shows up as an epoch bump; this
+    core's dwell clock must honor it without any gossip."""
+    pc = Counters("placement")
+    a, b = _two_hosts(tmp_path)
+    hb = {0: PartHeat(), 1: PartHeat()}
+    reb = _rebalancer_for(a, b, hb, pc)
+    assert reb.tick(now=50.0).moves == ()  # cold baseline: epochs noted
+    # both parts round-trip a→b→a by EXTERNAL decision (a peer's moves,
+    # as this core sees them: pure epoch bumps in the shared table)
+    eng_a = MigrationEngine(a, counters=pc)
+    eng_b = MigrationEngine(b, counters=pc)
+    for k in (0, 1):
+        eng_a.migrate(k, b.address,
+                      adopt=lambda k, addr: eng_b.adopt(k, a.owner_id))
+        eng_b.migrate(k, a.address,
+                      adopt=lambda k, addr: eng_a.adopt(k, b.owner_id))
+    # the load turns hot AFTER those moves: profitable but dwell-held
+    hb[0] = PartHeat(ops=60.0)
+    hb[1] = PartHeat(ops=30.0)
+    plan = reb.tick(now=51.0)
+    assert plan.moves == ()
+    assert plan.suppressed_hysteresis == 2
+    # dwell expiry releases the move
+    assert [m.k for m in reb.tick(now=70.0).moves] == [0]
+    for h in (a, b):
+        for s in h.servers.values():
+            s.log.close()

@@ -55,6 +55,12 @@ if [ "$run_soak" = 1 ]; then
     echo "--- chaos migration campaign (fixed seed, quick)"
     python -m fluidframework_tpu.chaos.migrate --seed 0 --quick
     echo "migrate: ok"
+    echo "--- chaos rebalance campaign (fixed seed, quick)"
+    # hotspot storm + flap bait + elastic 2->4->2 against the armed
+    # self-driving placement loop; full-mode seeds 0/7/42 add the
+    # core kill -9 + auto-heal phase (run manually before release)
+    python -m fluidframework_tpu.chaos.rebalance --seed 0 --quick
+    echo "rebalance: ok"
 fi
 
 echo "ci: all gates passed"

@@ -81,6 +81,11 @@ LOCKED_FAMILIES = {
                            "applier.stage.bytes",
                            "applier.stage.overlap_ratio",
                            "applier.exec.seconds"}),
+    # placement.heat.* are the rebalancer's windowed per-partition load
+    # series (labeled part=<k>); placement.rebalance.* count the
+    # self-driving loop's decisions — the storm bench's flap-free gate
+    # and the elastic-sweep audit key on these exact names
+    # (service/rebalancer.py)
     "placement.": frozenset({"placement.epoch.bumps",
                              "placement.epoch.stale_nacks",
                              "placement.cache.hits",
@@ -90,7 +95,14 @@ LOCKED_FAMILIES = {
                              "placement.migration.fences",
                              "placement.migration.committed",
                              "placement.migration.failed",
-                             "placement.migration.adopted"}),
+                             "placement.migration.adopted",
+                             "placement.heat.ops",
+                             "placement.heat.bytes",
+                             "placement.rebalance.ticks",
+                             "placement.rebalance.plans",
+                             "placement.rebalance.migrations_issued",
+                             "placement.rebalance.suppressed_hysteresis",
+                             "placement.rebalance.suppressed_budget"}),
     # the read-scale fan-out tier (ISSUE 12): the net-smoke relay gate
     # counter-asserts splices > 0 and encodes == 0 above the first
     # gateway level, and the read-storm bench keys on upstream bytes —
