@@ -499,7 +499,9 @@ def bench_network() -> dict:
     - BASELINE config-4 geometry: 1000 docs × 10 clients = 10,000 live
       sockets at a reduced per-client rate.
     """
+    import os
     import subprocess
+    import tempfile
     import time as _time
 
     def run_workers(ports: list, nworkers: int, docs: int, cpd: int,
@@ -620,11 +622,78 @@ def bench_network() -> dict:
         # engaged under load, reported as net_batching
         batching = _query_counters(port)
 
+        # relay-depth leg: a dedicated 2-level relay tree (leaf gateway
+        # dialing a mid gateway via --upstream-gateway; the mid runs the
+        # asyncio relay, which is the tier that SERVES the backbone
+        # protocol downward — the native epoll relay the knee gateways
+        # run does not stack). One short traced burst at the knee rate:
+        # each tier appends its own HOP_RELAY stamp, so the core's
+        # registry gains the relay_to_relay pair (the per-tier relay
+        # cost the flat gateway geometry can never witness)
+        mid, mid_port = _spawn_listening(
+            "fluidframework_tpu.service.gateway",
+            "--core-port", str(port), "--python")
+        leaf, leaf_port = _spawn_listening(
+            "fluidframework_tpu.service.gateway",
+            "--upstream-gateway", f"127.0.0.1:{mid_port}")
+        try:
+            run_workers([leaf_port], 2, 8, 2, knee_rate, 32,
+                        max(8, int(8 * knee_rate)), "rlyrly",
+                        extra=("--trace-sample-n", "4"))
+        finally:
+            leaf.terminate()
+            mid.terminate()
+            leaf.wait(timeout=10)
+            mid.wait(timeout=10)
+
         # per-hop-pair counts from the core's metrics registry over the
         # same window: the knee runs went through gateways with 1-in-16
         # trace sampling armed, so all four server-visible legs (submit→
-        # relay→admit→deli→fanout) must have counted
+        # relay→admit→deli→fanout) must have counted — plus
+        # relay_to_relay from the stacked-leaf burst above
         hop_breakdown = _query_hop_breakdown(port)
+
+        # device-dispatch leg: a split-service core (subprocess applier
+        # stage tailing the log, backchannel consumed by the core) — the
+        # applier's stage/execute wall stamps thread back over the
+        # backchannel and fold into the core's registry as
+        # stage_to_execute. Short burst, then poll until the fold lands
+        # (the stage checkpoints once per second).
+        split_dir = tempfile.mkdtemp(prefix="bench-split-")
+        log_dir = os.path.join(split_dir, "log")
+        state_dir = os.path.join(split_dir, "applier-state")
+        applier = subprocess.Popen(
+            _lean_cmd("fluidframework_tpu.service.stage_runner",
+                      "--stage", "applier", "--log-dir", log_dir,
+                      "--state-dir", state_dir),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=REPO, env=_lean_env())
+        assert applier.stdout.readline().strip() == "READY"
+        sfe = None
+        try:
+            sfe, sfe_port = _spawn_listening(
+                "fluidframework_tpu.service.front_end", "--port", "0",
+                "--log-dir", log_dir,
+                "--consume-backchannel", state_dir)
+            run_workers([sfe_port], 2, 8, 2, knee_rate, 32,
+                        max(8, int(8 * knee_rate)), "stgexe")
+            deadline = _time.monotonic() + 20.0
+            split_hops = _query_hop_breakdown(sfe_port)
+            while ("stage_to_execute" not in split_hops
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.25)
+                split_hops = _query_hop_breakdown(sfe_port)
+            for pair, n in split_hops.items():
+                hop_breakdown[pair] = hop_breakdown.get(pair, 0) + n
+        finally:
+            applier.terminate()
+            if sfe is not None:
+                sfe.terminate()
+                sfe.wait(timeout=10)
+            try:
+                applier.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                applier.kill()
 
         # armed/disarmed A/B at the knee rate: the sampling knob must
         # cost ~nothing when off AND ~nothing at 1-in-16 — two
@@ -638,6 +707,29 @@ def bench_network() -> dict:
                 knee_ports, 4, 64, 2, knee_rate, 32, rounds,
                 "aboff", extra=("--trace-sample-n", "0"))["ops_per_sec"],
         }
+
+        # audit-journal A/B at the knee rate: same geometry against two
+        # fresh direct-terminated cores, one with --journal armed. The
+        # journal only writes on control-plane EVENTS (never per op), so
+        # armed steady-state throughput must match disarmed within
+        # noise — the published proof the audit spine is free when idle
+        # and ~free when armed
+        journal_ab = {}
+        for tag, fe_extra in (
+                ("armed", ("--journal",
+                           os.path.join(tempfile.mkdtemp(
+                               prefix="bench-journal-"), "fe.jsonl"))),
+                ("disarmed", ())):
+            jfe, jport = _spawn_listening(
+                "fluidframework_tpu.service.front_end", "--port", "0",
+                *fe_extra)
+            try:
+                journal_ab[f"{tag}_ops_per_sec"] = run_workers(
+                    [jport], 4, 64, 2, knee_rate, 32, rounds,
+                    f"jab{tag}")["ops_per_sec"]
+            finally:
+                jfe.terminate()
+                jfe.wait(timeout=10)
 
         # ---- BASELINE config 4: 1000 docs × 10 clients, 4 gateways.
         # The 10× fan-out geometry has its own (lower) knee: step the
@@ -706,6 +798,7 @@ def bench_network() -> dict:
             "batching": batching,
             "hop_breakdown": hop_breakdown,
             "trace_ab": trace_ab,
+            "journal_ab": journal_ab,
         }
     finally:
         for gw, _ in gws:

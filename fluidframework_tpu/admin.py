@@ -12,7 +12,12 @@ one CLI against the ordering core's admin frames (front_end.py
     python -m fluidframework_tpu.admin tenant-rm ID --port P
     python -m fluidframework_tpu.admin monitor --port P [--interval S]
                                                [--count N]
-    python -m fluidframework_tpu.admin metrics --port P
+    python -m fluidframework_tpu.admin metrics --port P [--history]
+                                               [--name METRIC]
+    python -m fluidframework_tpu.admin journal --port P [-n N]
+        [--kind PREFIX] [--doc DOC] [--part K] [--fleet] [--chain ID]
+    python -m fluidframework_tpu.admin flight dump --port P [--reason R]
+    python -m fluidframework_tpu.admin bundle --out DIR --port P
     python -m fluidframework_tpu.admin --port P slo
     python -m fluidframework_tpu.admin placement --port P [--fleet]
     python -m fluidframework_tpu.admin placement heat --port P
@@ -37,6 +42,22 @@ as published in the epoch table) — point it at the CURRENT owner.
 ``slo`` prints one row per armed SLO spec — windowed p99 vs budget,
 state (ok/warn/violated), burn progress — plus whether SLO-burn
 shedding is armed (front_end ``--slo`` / ``--no-shed``).
+
+``journal`` tails the core's control-plane audit journal
+(obs/journal.py): every epoch bump, lease transfer, migration phase,
+rebalance decision (suppressions included), SLO transition and flight
+dump, each entry causally linked to what triggered it. ``--fleet``
+fans out to every registered core and merges the journals ordered by
+(epoch, ts) — the epoch table is the fleet's shared logical clock, so
+a cross-core migration reads as one connected chain even under
+wall-clock skew. ``--chain ID`` prints just the causal chain ending at
+the given entry id, root first. ``metrics --history`` prints the
+windowed series' retained history rings (~15 min at 10 s resolution)
+instead of the instantaneous scrape. ``flight dump`` forces a flight-
+recorder dump now and journals it. ``bundle --out DIR`` snapshots the
+whole debug surface — placement table, per-core scrape + history +
+journal tail + SLO/rebalancer status, reachable flight dumps — into
+DIR for ``tools/doctor.py`` to triage offline.
 
 ``monitor`` is the service-monitor role (ref: server/service-monitor):
 each tick it measures the front door's ping RTT (event-loop health) and
@@ -202,6 +223,175 @@ def _placement(args) -> int:
     return 0
 
 
+def _fmt_entry(e: dict) -> str:
+    import datetime
+
+    try:
+        ts = datetime.datetime.fromtimestamp(
+            e.get("ts", 0)).strftime("%H:%M:%S.%f")[:-3]
+    except (OverflowError, OSError, ValueError):
+        ts = str(e.get("ts"))
+    labels = " ".join(f"{k}={v}" for k, v in
+                      sorted((e.get("labels") or {}).items()))
+    cause = f" <- {e['cause']}" if e.get("cause") else ""
+    epoch = e.get("epoch")
+    return (f"{ts} e{epoch if epoch is not None else '-'} "
+            f"[{e.get('id')}] {e.get('kind')}{cause}  {labels}")
+
+
+def _journal_frame(args) -> dict:
+    frame = {"t": "admin_journal", "n": args.n}
+    if args.kind:
+        frame["kind"] = args.kind
+    if args.doc:
+        frame["doc"] = args.doc
+    if args.part is not None:
+        frame["part"] = args.part
+    return frame
+
+
+def _fleet_cores(args) -> dict:
+    """owner → addr for every registered member (falls back to the
+    queried core alone on an unsharded deployment)."""
+    pl = _request(args, {"t": "admin_placement"}).get("placement")
+    if pl is None or not pl.get("cores"):
+        return {"local": f"{args.host}:{args.port}"}
+    return {owner: row["addr"]
+            for owner, row in sorted(pl["cores"].items())}
+
+
+def _journal_cmd(args) -> int:
+    from .obs.journal import causal_chain, merge_entries
+
+    if args.fleet:
+        per_core = []
+        for owner, addr in _fleet_cores(args).items():
+            try:
+                j = _peer_request(args, addr, _journal_frame(args))[
+                    "journal"]
+            except (OSError, ValueError, RuntimeError) as e:
+                print(f"# core {owner} @ {addr} unreachable: {e}")
+                continue
+            per_core.append(j["entries"])
+        entries = merge_entries(per_core)
+    else:
+        j = _request(args, _journal_frame(args))["journal"]
+        if not j["armed"] and not j["entries"]:
+            print("journal: disarmed on this core (sharded cores arm "
+                  "automatically; single cores need --journal PATH)")
+            return 1
+        entries = j["entries"]
+    if args.chain:
+        entries = causal_chain(entries, args.chain)
+        if not entries:
+            print(f"no entry {args.chain!r} in the fetched window "
+                  "(raise -n or check the id)")
+            return 1
+    for e in entries:
+        print(_fmt_entry(e))
+    return 0
+
+
+def _metrics_history(args) -> int:
+    reply = _request(args, {"t": "admin_metrics_history",
+                            "name": args.name})
+    # points carry the CORE's monotonic clock; rebase onto wall time
+    # through the paired now stamps the RPC ships
+    offset = reply["now_wall"] - reply["now_mono"]
+    import datetime
+
+    for name, series in sorted(reply["history"].items()):
+        for s in series:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(s["labels"].items()))
+            print(f"{name}{{{labels}}}")
+            for pt in s["points"]:
+                wall = pt["t"] + offset
+                hhmm = datetime.datetime.fromtimestamp(
+                    wall).strftime("%H:%M:%S")
+                mean = pt["sum"] / pt["count"] if pt["count"] else 0.0
+                print(f"  {hhmm} count {pt['count']} "
+                      f"mean {mean:.3f} max {pt['max']:.3f}")
+    return 0
+
+
+def _bundle(args) -> int:
+    """Snapshot the fleet's debug surface into ``--out`` (the operator
+    door tools/doctor.py triages from)."""
+    import os
+    import shutil
+    import time
+
+    out = args.out
+    os.makedirs(out, exist_ok=True)
+    manifest: dict = {"created": time.time(),
+                      "entry": f"{args.host}:{args.port}", "cores": {}}
+    pl = _request(args, {"t": "admin_placement"}).get("placement")
+    if pl is not None:
+        with open(os.path.join(out, "placement.json"), "w") as f:
+            json.dump(pl, f, indent=2, default=str)
+    cores = _fleet_cores(args)
+    for owner, addr in cores.items():
+        cdir = os.path.join(out, "cores", owner)
+        os.makedirs(cdir, exist_ok=True)
+        row: dict = {"addr": addr}
+        manifest["cores"][owner] = row
+        try:
+            scrape = _peer_request(
+                args, addr, {"t": "admin_metrics_scrape"})["scrape"]
+            with open(os.path.join(cdir, "scrape.prom"), "w") as f:
+                f.write(scrape)
+            counters = _peer_request(
+                args, addr, {"t": "admin_counters"})["counters"]
+            with open(os.path.join(cdir, "counters.json"), "w") as f:
+                json.dump(counters, f, indent=2, default=str)
+            hist = _peer_request(args, addr,
+                                 {"t": "admin_metrics_history"})
+            with open(os.path.join(cdir, "history.json"), "w") as f:
+                json.dump(hist, f, default=str)
+            slo = _peer_request(args, addr, {"t": "admin_slo_status"})
+            with open(os.path.join(cdir, "slo.json"), "w") as f:
+                json.dump({"slos": slo.get("slos", []),
+                           "shedding": slo.get("shedding")}, f, indent=2)
+            reb = _peer_request(
+                args, addr,
+                {"t": "admin_rebalance_status"})["rebalance"]
+            with open(os.path.join(cdir, "rebalance.json"), "w") as f:
+                json.dump(reb, f, indent=2, default=str)
+            j = _peer_request(args, addr, {"t": "admin_journal",
+                                           "n": 1000})["journal"]
+            row["journal_armed"] = j["armed"]
+            with open(os.path.join(cdir, "journal.jsonl"), "w") as f:
+                for e in j["entries"]:
+                    f.write(json.dumps(e, separators=(",", ":"),
+                                       default=str) + "\n")
+            # flight dumps the journal references, when their paths are
+            # readable from here (same-host deployments — the common
+            # debug case; remote cores just keep the path breadcrumbs)
+            copied = 0
+            for e in j["entries"]:
+                if e.get("kind") != "flight.dump":
+                    continue
+                path = (e.get("labels") or {}).get("path")
+                if path and os.path.isfile(path):
+                    fdir = os.path.join(cdir, "flight")
+                    os.makedirs(fdir, exist_ok=True)
+                    try:
+                        shutil.copy(path, fdir)
+                        copied += 1
+                    except OSError:
+                        pass
+            row["flight_dumps_copied"] = copied
+        except (OSError, ValueError, RuntimeError) as e:
+            row["error"] = str(e)
+            print(f"# core {owner} @ {addr} partially captured: {e}")
+    with open(os.path.join(out, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(f"bundle written to {out} ({len(cores)} core(s)); triage "
+          f"with: python tools/doctor.py {out}")
+    return 0
+
+
 def main(argv=None) -> int:
     # the connection options are accepted before OR after the
     # subcommand (`admin --port P slo` and `admin slo --port P` both
@@ -235,8 +425,43 @@ def main(argv=None) -> int:
     s.add_argument("--interval", type=float, default=2.0)
     s.add_argument("--count", type=int, default=0,
                    help="ticks before exiting (0 = forever)")
-    sub.add_parser("metrics", parents=[common],
-                   help="Prometheus text scrape of the core's registry")
+    s = sub.add_parser("metrics", parents=[common],
+                       help="Prometheus text scrape of the core's "
+                            "registry (--history: retained windowed "
+                            "series rings instead)")
+    s.add_argument("--history", action="store_true",
+                   help="print the ~15 min windowed-series history "
+                        "rings instead of the instantaneous scrape")
+    s.add_argument("--name", default=None,
+                   help="restrict --history to one windowed metric")
+    s = sub.add_parser("journal", parents=[common],
+                       help="tail the control-plane audit journal "
+                            "(epoch bumps, leases, migrations, "
+                            "rebalance decisions, SLO transitions)")
+    s.add_argument("-n", type=int, default=100,
+                   help="entries per core (default 100)")
+    s.add_argument("--kind", default=None,
+                   help="kind prefix filter (e.g. migration.)")
+    s.add_argument("--doc", default=None, help="doc label filter")
+    s.add_argument("--part", type=int, default=None,
+                   help="partition label filter")
+    s.add_argument("--fleet", action="store_true",
+                   help="merge every core's journal ordered by "
+                        "(epoch, ts)")
+    s.add_argument("--chain", default=None, metavar="ID",
+                   help="print the causal chain ending at entry ID, "
+                        "root first")
+    s = sub.add_parser("flight", parents=[common],
+                       help="flight recorder controls: `flight dump` "
+                            "forces a dump now and journals it")
+    s.add_argument("action", choices=["dump"])
+    s.add_argument("--reason", default=None,
+                   help="free-text reason recorded in the journal")
+    s = sub.add_parser("bundle", parents=[common],
+                       help="capture a fleet debug bundle (placement, "
+                            "scrapes, history, journals, SLO status, "
+                            "flight dumps) into --out")
+    s.add_argument("--out", required=True, metavar="DIR")
     sub.add_parser("slo", parents=[common],
                    help="armed SLO specs: windowed p99 vs "
                         "budget, state, burn progress")
@@ -277,8 +502,18 @@ def main(argv=None) -> int:
             return 1
         print(json.dumps(reply["status"], indent=2))
     elif args.cmd == "metrics":
+        if args.history:
+            return _metrics_history(args)
         reply = _request(args, {"t": "admin_metrics_scrape"})
         sys.stdout.write(reply["scrape"])
+    elif args.cmd == "journal":
+        return _journal_cmd(args)
+    elif args.cmd == "flight":
+        reply = _request(args, {"t": "admin_flight_dump",
+                                "reason": args.reason})
+        print(f"dumped {reply['path']} (journal {reply['journal']})")
+    elif args.cmd == "bundle":
+        return _bundle(args)
     elif args.cmd == "slo":
         reply = _request(args, {"t": "admin_slo_status"})
         shed = "armed" if reply.get("shedding") else "off"

@@ -37,7 +37,7 @@ from ..obs import tier_counters
 from ..protocol import binwire
 from ..protocol.messages import MessageType, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
-from ..utils.telemetry import HOP_SUBMIT, Counters
+from ..utils.telemetry import HOP_SHED, HOP_SUBMIT, Counters
 from .definitions import (
     DocumentDeltaConnection,
     DocumentDeltaStorage,
@@ -370,6 +370,11 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
         # stream would gap at deli
         self._shed_ops: list = []
         self._shed_deadline: Optional[float] = None
+        # wall clock of the EARLIEST park since the last shed flush:
+        # when the held ops finally flush, the frame carries a HOP_SHED
+        # stamp at this time so shed_to_submit measures park duration
+        self._shed_park_wall: Optional[float] = None
+        self._pending_shed_wall: Optional[float] = None
 
         def on_ops(f):
             for d in f["msgs"]:
@@ -433,6 +438,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             if self._coal_closed:
                 return
             self._shed_ops.append(op)
+            if self._shed_park_wall is None:
+                self._shed_park_wall = time.time()
             self._shed_deadline = max(self._shed_deadline or 0.0,
                                       time.monotonic() + delay)
             self._ensure_flusher()
@@ -585,6 +592,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                         ops = self._shed_ops + self._pending_ops
                         self._shed_ops = []
                         self._shed_deadline = None
+                        self._pending_shed_wall = self._shed_park_wall
+                        self._shed_park_wall = None
                     else:
                         ops = self._pending_ops
                     self._flush_deadline = None
@@ -613,6 +622,8 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                 with self._coal_cv:
                     if not self._coal_closed:
                         self._shed_ops[:0] = ops[i:]
+                        if self._shed_park_wall is None:
+                            self._shed_park_wall = time.time()
                         self._shed_deadline = max(
                             self._shed_deadline or 0.0,
                             time.monotonic() + 0.5)
@@ -621,10 +632,14 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                 raise
 
     def _send_chunk(self, chunk: list) -> None:
+        shed_wall, self._pending_shed_wall = self._pending_shed_wall, None
         sample = False
         if self.trace_sample_n:
             self._trace_seq += 1
-            sample = self._trace_seq % self.trace_sample_n == 0
+            # shed flushes are force-sampled: park time is exactly the
+            # tail latency the hop breakdown exists to attribute
+            sample = (self._trace_seq % self.trace_sample_n == 0
+                      or shed_wall is not None)
         # columnar first: a canonical chanop boxcar rides the
         # fixed-stride column frame the server admits without
         # materializing per-op objects (kind stays "submit" so the
@@ -637,11 +652,18 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
                 # hoptail append keeps the op columns untouched —
                 # stamping traces on the op itself would kick the
                 # boxcar off the columnar path entirely
+                if shed_wall is not None:
+                    body = binwire.append_hop(
+                        body, HOP_SHED, shed_wall)
                 body = binwire.append_hop(
                     body, HOP_SUBMIT, time.time())
                 self.counters.inc("driver.trace.sampled")
         else:
             if sample:
+                if shed_wall is not None:
+                    chunk[-1].traces.append(TraceHop(
+                        service="frontend", action="shed",
+                        timestamp=shed_wall))
                 chunk[-1].traces.append(TraceHop(
                     service="client", action="submit",
                     timestamp=time.time()))

@@ -10,6 +10,17 @@ from .flight import (  # noqa: F401
     get_recorder,
     reset_recorder,
 )
+from .journal import (  # noqa: F401
+    KINDS as JOURNAL_KINDS,
+    Journal,
+    arm_journal,
+    causal_chain,
+    filter_entries,
+    get_journal,
+    merge_entries,
+    read_journal,
+    reset_journal,
+)
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     WindowedSeries,

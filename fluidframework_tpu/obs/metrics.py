@@ -46,6 +46,11 @@ DEFAULT_MAX_SERIES = 256
 DEFAULT_WINDOW_S = 10.0
 DEFAULT_WINDOW_BUCKETS = 10
 
+#: History-ring defaults: ~15 min retained at 10 s resolution. Memory
+#: is bounded per series at horizon/resolution slots of 4 numbers.
+DEFAULT_HISTORY_S = 900.0
+DEFAULT_HISTORY_RES_S = 10.0
+
 _PREFIX = "fluid_"
 
 
@@ -100,33 +105,75 @@ class WindowedSeries:
     of every bucket still inside the window, so quantiles reflect the
     last window, not process lifetime (the cumulative ``_Series``
     keeps that role). Per-bucket samples are a seeded reservoir with
-    the true count kept separately."""
+    the true count kept separately.
+
+    History ring (PR 14): a bucket expiring out of the live window is
+    RETIRED — its (count, sum, max) folds into a coarse history slot
+    (``history_res_s`` wide, default 10 s) retained for ``history_s``
+    (default ~15 min), so a blip's before/after survives long past the
+    live window at bounded memory (no samples are retained — count/
+    sum/max only). ``history()`` merges retained slots with the live
+    buckets, so the newest points appear immediately."""
 
     __slots__ = ("width", "buckets", "max_per_bucket", "_epochs",
-                 "_counts", "_sums", "_samples", "_rng")
+                 "_counts", "_sums", "_maxs", "_samples", "_rng",
+                 "history_res", "_hist_slots", "_history")
 
     def __init__(self, window_s: float = DEFAULT_WINDOW_S,
                  buckets: int = DEFAULT_WINDOW_BUCKETS,
-                 max_per_bucket: int = 512):
+                 max_per_bucket: int = 512,
+                 history_s: float = DEFAULT_HISTORY_S,
+                 history_res_s: float = DEFAULT_HISTORY_RES_S):
         self.width = window_s / buckets
         self.buckets = buckets
         self.max_per_bucket = max_per_bucket
         self._epochs = [-1] * buckets
         self._counts = [0] * buckets
         self._sums = [0.0] * buckets
+        self._maxs = [0.0] * buckets
         self._samples: list[list[float]] = [[] for _ in range(buckets)]
         self._rng = random.Random(0)
+        self.history_res = max(history_res_s, self.width)
+        self._hist_slots = max(1, int(history_s / self.history_res))
+        # slot index (monotonic // history_res) → [count, sum, max];
+        # a dict (not a deque) because lazy retirement delivers buckets
+        # out of order by up to a ring span
+        self._history: dict[int, list[float]] = {}
+
+    def _retire(self, epoch: int, count: int, vsum: float,
+                vmax: float) -> None:
+        """Fold an expiring live bucket into its history slot and
+        prune slots past the horizon — bounded memory by construction."""
+        slot = int(epoch * self.width / self.history_res)
+        h = self._history.get(slot)
+        if h is None:
+            self._history[slot] = [count, vsum, vmax]
+            if len(self._history) > self._hist_slots:
+                lo = slot - self._hist_slots
+                for s in [s for s in self._history if s <= lo]:
+                    del self._history[s]
+        else:
+            h[0] += count
+            h[1] += vsum
+            if vmax > h[2]:
+                h[2] = vmax
 
     def observe(self, value: float, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         e = int(now / self.width)
         i = e % self.buckets
         if self._epochs[i] != e:
+            if self._counts[i]:
+                self._retire(self._epochs[i], self._counts[i],
+                             self._sums[i], self._maxs[i])
             self._epochs[i] = e
             self._counts[i] = 0
             self._sums[i] = 0.0
+            self._maxs[i] = 0.0
             self._samples[i] = []
         self._sums[i] += value
+        if value > self._maxs[i]:
+            self._maxs[i] = value
         n = self._counts[i] = self._counts[i] + 1
         s = self._samples[i]
         if len(s) < self.max_per_bucket:
@@ -135,6 +182,34 @@ class WindowedSeries:
             j = self._rng.randrange(n)
             if j < self.max_per_bucket:
                 s[j] = value
+
+    def history(self, now: Optional[float] = None) -> list[dict]:
+        """Retained + live points, oldest first, one per history slot:
+        ``{"t": slot start (monotonic s), "count", "sum", "max"}``.
+        Live buckets (not yet retired) merge in on read, so the series
+        is current without waiting for expiry."""
+        now = time.monotonic() if now is None else now
+        lo = int(now / self.history_res) - self._hist_slots
+        merged: dict[int, list[float]] = {
+            s: list(v) for s, v in self._history.items() if s > lo}
+        for i in range(self.buckets):
+            if self._epochs[i] < 0 or not self._counts[i]:
+                continue
+            slot = int(self._epochs[i] * self.width / self.history_res)
+            if slot <= lo:
+                continue
+            h = merged.get(slot)
+            if h is None:
+                merged[slot] = [self._counts[i], self._sums[i],
+                                self._maxs[i]]
+            else:
+                h[0] += self._counts[i]
+                h[1] += self._sums[i]
+                if self._maxs[i] > h[2]:
+                    h[2] = self._maxs[i]
+        return [{"t": slot * self.history_res, "count": int(c),
+                 "sum": s, "max": m}
+                for slot, (c, s, m) in sorted(merged.items())]
 
     def stats(self, now: Optional[float] = None,
               window_s: Optional[float] = None) -> tuple[int, list]:
@@ -281,6 +356,33 @@ class MetricsRegistry:
             if lv is None:
                 continue
             out[lv] = out.get(lv, 0.0) + ws.sum(now, window_s)
+        return out
+
+    def window_history(self, name: Optional[str] = None,
+                       now: Optional[float] = None, **labels) -> dict:
+        """Retained history of every windowed series (or just
+        ``name``), label-filtered by subset match — the read behind
+        ``admin_metrics_history``:
+
+            {name: [{"labels": {...}, "points": [...]}]}
+
+        Points are :meth:`WindowedSeries.history` dicts; ``t`` is
+        process-monotonic seconds (the RPC layer ships ``now_mono`` +
+        ``now_wall`` alongside so clients can rebase to wall time)."""
+        want = [(k, str(v)) for k, v in labels.items()]
+        with self._lock:
+            names = [name] if name is not None else list(self._windows)
+            matched = [
+                (n, key, ws)
+                for n in names
+                for key, ws in self._windows.get(n, {}).items()
+                if all(kv in key for kv in want)]
+        out: dict[str, list] = {}
+        for n, key, ws in matched:
+            points = ws.history(now)
+            if points:
+                out.setdefault(n, []).append(
+                    {"labels": dict(key), "points": points})
         return out
 
     def register_tier(self, tier: str, counters: Counters) -> None:

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .flight import get_recorder
+from .journal import get_journal
 from .metrics import get_registry
 
 STATE_OK = 0
@@ -91,11 +92,12 @@ class SloEngine:
     ``violated_pairs``, both swapped atomically."""
 
     def __init__(self, specs, registry=None, tick_s: float = 0.5,
-                 recorder=None):
+                 recorder=None, journal=None):
         self.specs = list(specs)
         self.tick_s = tick_s
         self._reg = registry or get_registry()
         self._recorder = recorder
+        self.journal = journal if journal is not None else get_journal()
         self._burn = {s.name: 0 for s in self.specs}
         self._state = {s.name: STATE_OK for s in self.specs}
         self._last: dict[str, tuple[int, float]] = {}
@@ -133,20 +135,33 @@ class SloEngine:
             else:
                 self._burn[s.name] = 0
                 state = STATE_OK
+            dump_id = None
             if state == STATE_VIOLATED:
                 violated.add(s.pair)
                 if prev != STATE_VIOLATED:
                     self._reg.inc("obs.slo.violations", slo=s.name)
                     try:
                         rec = self._recorder or get_recorder()
-                        rec.dump("slo_violation", slo=s.name, pair=s.pair,
-                                 tenant=s.tenant, p99_ms=round(p99, 3),
-                                 budget_ms=s.p99_budget_ms, count=count)
+                        path = rec.dump(
+                            "slo_violation", slo=s.name, pair=s.pair,
+                            tenant=s.tenant, p99_ms=round(p99, 3),
+                            budget_ms=s.p99_budget_ms, count=count)
+                        # the dump is the violation's evidence: journal
+                        # it, then link the state transition to it so
+                        # the bundle can join incident → frames
+                        dump_id = self.journal.emit(
+                            "flight.dump", reason="slo_violation",
+                            path=path, slo=s.name)
                     except Exception:
                         pass
             if state != prev:
                 self._state[s.name] = state
                 self._reg.set_gauge("obs.slo.state", state, slo=s.name)
+                self.journal.emit(
+                    "slo.state", cause=dump_id, slo=s.name, pair=s.pair,
+                    tenant=s.tenant, state=_STATE_NAMES[state],
+                    prev=_STATE_NAMES[prev], p99_ms=round(p99, 3),
+                    budget_ms=s.p99_budget_ms, count=count)
         self.violated_pairs = frozenset(violated)
         self.shed_signal = bool(violated)
         return self.status()

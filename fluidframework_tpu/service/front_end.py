@@ -60,11 +60,12 @@ import threading
 import time
 from typing import Any, Optional
 
-from ..obs import get_recorder, get_registry, tier_counters
+from ..obs import get_journal, get_recorder, get_registry, tier_counters
 from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType, Signal, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
-from ..utils.telemetry import HOP_ADMIT, HOP_SERVICE_ACTION, hop_pairs
+from ..utils.telemetry import (HOP_ADMIT, HOP_SERVICE_ACTION,
+                               count_unknown_hops, hop_pairs)
 from .admission import AdmissionController, retry_after_ms
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
@@ -107,6 +108,12 @@ def _stamp_abatch(batch, topic=None, tenant=None) -> bytes:
         if tenant is None and topic:
             tenant = topic.partition("/")[0]
         reg = get_registry()
+        unknown = count_unknown_hops(hops)
+        if unknown:
+            # a hop id past this build's taxonomy (version-skewed
+            # client): COUNT it rather than silently dropping, so a
+            # breakdown that quietly lost legs is visible in the scrape
+            reg.inc("obs.trace.unknown_hops", unknown)
         for pair, ms in hop_pairs(hops):
             # cumulative summary (lifetime) and its windowed twin (the
             # SLO engine's read source) — both per sampled batch only,
@@ -481,7 +488,9 @@ class _ClientSession:
                        "admin_placement", "admin_migrate_doc",
                        "admin_adopt_partition", "admin_core_heat",
                        "admin_tier_snapshot", "admin_rebalance_status",
-                       "admin_placement_drain", "admin_migrate_part"):
+                       "admin_placement_drain", "admin_migrate_part",
+                       "admin_journal", "admin_metrics_history",
+                       "admin_flight_dump"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -1091,6 +1100,8 @@ class _ClientSession:
                 tenant, doc)
             if front._log_flush and hasattr(server.log, "flush"):
                 server.log.flush()
+            get_journal().emit("summary.commit", tenant=tenant, doc=doc,
+                               version=version, forced=True)
             self.push("admin", {"rid": rid, "version": version})
         elif t == "admin_tenant_add":
             if tenants is None:
@@ -1156,8 +1167,12 @@ class _ClientSession:
 
             tenant, doc = frame["tenant"], frame["doc"]
             k = doc_partition(tenant, doc, sh.n)
+            op_id = sh.journal.emit(
+                "operator.command", command=t, tenant=tenant, doc=doc,
+                part=k, target=frame["target"])
             result = front.migration_engine.migrate(
-                k, frame["target"], on_flip=front._on_migration_flip)
+                k, frame["target"], on_flip=front._on_migration_flip,
+                cause=op_id)
             self.push("admin", {"rid": rid, **result})
         elif t == "admin_adopt_partition":
             # core→core handoff target side (MigrationEngine._rpc_adopt)
@@ -1165,7 +1180,8 @@ class _ClientSession:
             if sh is None:
                 raise ValueError("not a sharded core")
             result = front.migration_engine.adopt(
-                int(frame["k"]), frame["from_owner"])
+                int(frame["k"]), frame["from_owner"],
+                cause=frame.get("journal_cause"))
             self.push("admin", {"rid": rid, **result})
         elif t == "admin_core_heat":
             # read-only: this core's windowed per-partition heat — the
@@ -1216,7 +1232,10 @@ class _ClientSession:
                 raise ValueError("not a sharded core")
             from .placement_plane import CORE_DRAINING
 
-            ok = sh.table.set_core_state(frame["owner"], CORE_DRAINING)
+            op_id = sh.journal.emit("operator.command", command=t,
+                                    owner=frame["owner"])
+            ok = sh.table.set_core_state(frame["owner"], CORE_DRAINING,
+                                         cause=op_id)
             if not ok:
                 raise ValueError(
                     f"unknown core {frame['owner']!r} (not registered)")
@@ -1231,10 +1250,54 @@ class _ClientSession:
             sh = front.shard_host
             if sh is None:
                 raise ValueError("not a sharded core")
+            # a rebalancer loopback carries its actuation entry id as
+            # journal_cause; a bare operator call roots its own chain
+            cause = frame.get("journal_cause") or sh.journal.emit(
+                "operator.command", command=t, part=int(frame["k"]),
+                target=frame["target"])
             result = front.migration_engine.migrate(
                 int(frame["k"]), frame["target"],
-                on_flip=front._on_migration_flip)
+                on_flip=front._on_migration_flip, cause=cause)
             self.push("admin", {"rid": rid, **result})
+        elif t == "admin_journal":
+            # read-only: this core's audit-journal tail (the `admin
+            # journal` CLI and the fleet merge both read this); a
+            # disarmed journal answers empty rather than erroring so a
+            # fleet fan-out over mixed deployments still completes
+            jr = get_journal()
+            part = frame.get("part")
+            entries = jr.tail(
+                n=int(frame.get("n", 100)),
+                kind=frame.get("kind") or None,
+                doc=frame.get("doc"),
+                part=int(part) if part is not None else None)
+            self.push("admin", {"rid": rid, "journal": {
+                "armed": jr.armed, "core": jr.core, "path": jr.path,
+                "entries": entries}})
+        elif t == "admin_metrics_history":
+            # read-only: the windowed series' retained history rings.
+            # Points are stamped on THIS process's monotonic clock, so
+            # both clocks ride along and the caller rebases:
+            # wall = now_wall - (now_mono - t)
+            self.push("admin", {
+                "rid": rid,
+                "history": get_registry().window_history(
+                    frame.get("name")),
+                "now_mono": time.monotonic(),
+                "now_wall": time.time()})
+        elif t == "admin_flight_dump":
+            # operator door onto the flight recorder: dump the rings NOW
+            # (incident in progress, evidence wanted before it scrolls
+            # out) and journal the dump so the bundle joins both
+            jr = get_journal()
+            op_id = jr.emit("operator.command", command=t,
+                            reason=frame.get("reason") or "operator")
+            path = get_recorder().dump(
+                "operator", detail=frame.get("reason") or "operator")
+            dump_id = jr.emit("flight.dump", cause=op_id,
+                              reason="operator", path=path)
+            self.push("admin", {"rid": rid, "path": path,
+                                "journal": dump_id})
 
     def _unsubscribe_ftopic(self, topic: str) -> None:
         entry = self._ftopics.pop(topic, None)
@@ -1358,6 +1421,11 @@ class ShardHost:
         # the front end closes the partition's live sessions so clients
         # reconnect to the takeover owner
         self.on_drop = None
+        # control-plane audit journal (obs/journal.py): the process
+        # singleton, disarmed (free) unless main() armed it — lease
+        # lifecycle events land here next to the epoch bumps the table
+        # itself records
+        self.journal = get_journal()
         # elastic membership: set from the epoch table's cores section
         # each poll — a draining host claims nothing (the rebalancer
         # evacuates what it still owns)
@@ -1458,6 +1526,8 @@ class ShardHost:
                 server = self.servers.pop(k)
                 self.claim_epochs.pop(k, None)
                 server.revoke()
+                self.journal.emit("lease.takeover", part=k,
+                                  lost_by=self.owner_id)
                 if self.on_drop is not None:
                     self.on_drop(k, server)
         in_grace = (time.monotonic() - self._start_t
@@ -1470,8 +1540,11 @@ class ShardHost:
             if k not in self.prefer and in_grace:
                 continue  # let the preferring core take it first
             if self.placement.try_claim(k, self.owner_id, self.address):
+                claim_id = self.journal.emit(
+                    "lease.claim", part=k, owner=self.owner_id,
+                    takeover=k not in self.prefer)
                 self.claim_epochs[k] = self.table.record_claim(
-                    k, self.owner_id, self.address or "")
+                    k, self.owner_id, self.address or "", cause=claim_id)
                 self.table_epochs[k] = self.claim_epochs[k]
                 self.hb_times[k] = time.monotonic()
                 self.servers[k] = self._make_server(k)
@@ -1479,9 +1552,12 @@ class ShardHost:
     def release_all(self) -> None:
         for k in list(self.servers):
             self.placement.release(k, self.owner_id)
-            self.table.record_release(k, self.owner_id)
+            rel_id = self.journal.emit("lease.release", part=k,
+                                       owner=self.owner_id)
+            self.table.record_release(k, self.owner_id, cause=rel_id)
             self.claim_epochs.pop(k, None)
         self.servers.clear()
+        self.journal.emit("core.stop", owner=self.owner_id)
 
 
 class NetworkFrontEnd:
@@ -1629,14 +1705,19 @@ class NetworkFrontEnd:
             "budget": budget, "improvement": improvement}
         return self
 
-    def _rebalance_actuate(self, k: int, target_addr: str) -> None:
+    def _rebalance_actuate(self, k: int, target_addr: str,
+                           cause: Optional[str] = None) -> None:
         """Actuation seam for the rebalancer's ticker THREAD: a loopback
         ``admin_migrate_part`` RPC against our own event loop, so the
         seal→fence→handoff runs exactly where the operator door runs it
-        (single-threaded, no submit frame can interleave)."""
+        (single-threaded, no submit frame can interleave). ``cause`` is
+        the rebalance.actuate journal id; it rides the frame so the
+        migration chain roots at the plan, not at the loopback RPC."""
         from .placement_plane import admin_rpc
 
         frame = {"t": "admin_migrate_part", "k": k, "target": target_addr}
+        if cause is not None:
+            frame["journal_cause"] = cause
         if self.admin_secret:
             frame["secret"] = self.admin_secret
         admin_rpc(self.host, self.port, frame)
@@ -1805,8 +1886,11 @@ class NetworkFrontEnd:
             # the flight rings before dropping the connection.
             self.logger.error("conn_unhandled", message=str(e))
             try:
-                recorder.dump("frontend_unhandled", conn=conn_id,
-                              error=str(e))
+                path = recorder.dump("frontend_unhandled", conn=conn_id,
+                                     error=str(e))
+                get_journal().emit("flight.dump",
+                                   reason="frontend_unhandled",
+                                   path=path, conn=conn_id)
             except Exception:
                 pass
         finally:
@@ -1846,6 +1930,16 @@ class NetworkFrontEnd:
             orderer.apply_retention(rec["capture_seq"])
         elif kind == "applied":
             self.applier_status[(tenant, doc)] = rec["applied_seq"]
+            # hoptail thread across the process boundary: the applier
+            # stage's stage/execute wall stamps fold into THIS core's
+            # registry so net_hop_breakdown attributes device dispatch
+            hops = rec.get("wave_hops")
+            if hops and len(hops) == 2:
+                ms = (hops[1] - hops[0]) * 1e3
+                reg = get_registry()
+                reg.observe("obs.hop.ms", ms, pair="stage_to_execute")
+                reg.observe_windowed("obs.hop.window_ms", ms,
+                                     pair="stage_to_execute")
 
     def enable_summarizer(self, every: int) -> "NetworkFrontEnd":
         """Arm the threshold-driven service-summarizer loop: every doc
@@ -1893,6 +1987,10 @@ class NetworkFrontEnd:
                     if wrote and self._log_flush and \
                             hasattr(server.log, "flush"):
                         server.log.flush()
+                    if wrote:
+                        get_journal().emit(
+                            "summary.commit", docs=wrote,
+                            part=getattr(server, "part_k", None))
             except Exception as e:  # noqa: BLE001 — the loop must outlive
                 # one doc's refusal/IO error
                 self.logger.error("summarize_loop_error", message=str(e))
@@ -2137,6 +2235,11 @@ def main() -> None:
     parser.add_argument("--admin-secret", default=None,
                         help="shared secret gating the admin RPCs "
                              "(required when tenancy is enforcing)")
+    parser.add_argument("--journal", default=None, metavar="PATH",
+                        help="arm the control-plane audit journal at "
+                             "PATH (sharded cores arm automatically "
+                             "under the shard dir; this is the "
+                             "single-pipeline / bench A/B door)")
     # overload-control loop (see service/admission.py + obs/slo.py)
     parser.add_argument("--tenant-rate", action="append", default=[],
                         metavar="ID:RATE[:BURST]",
@@ -2196,6 +2299,27 @@ def main() -> None:
         shard_host = ShardHost(args.shard_dir, args.shards, prefer=prefer,
                                storage_server=storage_server,
                                ttl_s=args.lease_ttl)
+        # audit journal: one JSONL per core under the shard dir (admin
+        # journal --fleet merges them); the file is named by the core's
+        # STABLE role (its preferred partitions) so a restarted core
+        # reopens its own journal and continues the id space — that is
+        # what makes core.recover detectable. The epoch stamp reads the
+        # mtime-cached table, so each emit costs one stat.
+        import os as _os
+
+        from ..obs import arm_journal
+
+        core_name = ("core-" + "-".join(str(k) for k in prefer)
+                     if prefer else shard_host.owner_id)
+        table = shard_host.table
+        jr = arm_journal(
+            _os.path.join(args.shard_dir, "journal",
+                          f"{core_name}.jsonl"),
+            core=core_name,
+            epoch_fn=lambda: table.read().get("epoch", 0))
+        jr.emit("core.recover" if jr.seq else "core.start",
+                owner=shard_host.owner_id, shards=args.shards,
+                prefer=prefer)
         _gc.freeze()
         _gc.disable()
         front = NetworkFrontEnd(host=args.host, port=args.port,
@@ -2252,6 +2376,11 @@ def main() -> None:
     gc.freeze()
     gc.disable()
 
+    if args.journal:
+        from ..obs import arm_journal
+
+        jr = arm_journal(args.journal, core="fe")
+        jr.emit("core.recover" if jr.seq else "core.start", owner="fe")
     front = NetworkFrontEnd(server=server, host=args.host, port=args.port,
                             max_message_size=args.max_message_size,
                             admin_secret=args.admin_secret)

@@ -42,7 +42,7 @@ import tempfile
 import time
 from typing import Callable, Optional
 
-from ..obs import tier_counters
+from ..obs import get_journal, tier_counters
 from .placement import PlacementDir
 
 #: subdirectory of the shard dir holding the routing table
@@ -95,12 +95,15 @@ class EpochTable:
     never a wrong route that sticks.
     """
 
-    def __init__(self, directory: str, counters=None):
+    def __init__(self, directory: str, counters=None, journal=None):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, "table.json")
         self._lock_path = os.path.join(directory, "table.lock")
         self.counters = (counters if counters is not None
                          else tier_counters("placement"))
+        # audit journal: disarmed singleton by default (free), or an
+        # injected per-core instance (in-proc multi-core tests)
+        self.journal = journal if journal is not None else get_journal()
         self._cache: Optional[dict] = None
         self._cache_stamp = None
 
@@ -168,9 +171,12 @@ class EpochTable:
             json.dump(rec, f)
         os.replace(tmp, self.path)
 
-    def record_claim(self, k: int, owner: str, addr: str) -> int:
+    def record_claim(self, k: int, owner: str, addr: str,
+                     cause: Optional[str] = None) -> int:
         """Record that ``owner@addr`` now serves partition ``k`` (initial
-        claim, takeover, or migration adoption). Returns the new epoch."""
+        claim, takeover, or migration adoption). Returns the new epoch.
+        ``cause`` links the journal's ``epoch.bump`` to the event that
+        drove the ownership change (a migration adopt, a takeover)."""
         with _flock(self._lock_path):
             rec = self._read_fresh()
             rec["epoch"] += 1
@@ -178,9 +184,12 @@ class EpochTable:
                 "owner": owner, "addr": addr, "epoch": rec["epoch"]}
             self._write(rec)
         self.counters.inc("placement.epoch.bumps")
+        self.journal.emit("epoch.bump", cause=cause, epoch=rec["epoch"],
+                          part=k, owner=owner, addr=addr, change="claim")
         return rec["epoch"]
 
-    def record_release(self, k: int, owner: str) -> Optional[int]:
+    def record_release(self, k: int, owner: str,
+                       cause: Optional[str] = None) -> Optional[int]:
         """Drop ``k``'s route if ``owner`` still holds it; the bump makes
         the removal itself ordered (a cached route older than the release
         epoch is discardable)."""
@@ -193,6 +202,8 @@ class EpochTable:
             del rec["parts"][str(k)]
             self._write(rec)
         self.counters.inc("placement.epoch.bumps")
+        self.journal.emit("epoch.bump", cause=cause, epoch=rec["epoch"],
+                          part=k, owner=owner, change="release")
         return rec["epoch"]
 
     def record_core(self, owner: str, addr: str) -> None:
@@ -214,11 +225,13 @@ class EpochTable:
                 "state": prev["state"] if prev else CORE_ACTIVE}
             self._write(rec)
 
-    def set_core_state(self, owner: str, state: str) -> bool:
+    def set_core_state(self, owner: str, state: str,
+                       cause: Optional[str] = None) -> bool:
         """Flip a member's state (``admin placement drain``, or the
         rebalancer marking a drained core). False for unknown owners —
         draining a core that never registered is an operator typo, not
         a pending instruction."""
+        changed = False
         with _flock(self._lock_path):
             rec = self._read_fresh()
             row = rec.get("cores", {}).get(owner)
@@ -227,14 +240,24 @@ class EpochTable:
             if row["state"] != state:
                 row["state"] = state
                 self._write(rec)
+                changed = True
+        if changed:
+            self.journal.emit("core.state", cause=cause,
+                             epoch=rec["epoch"], owner=owner, state=state)
         return True
 
-    def remove_core(self, owner: str) -> None:
+    def remove_core(self, owner: str, cause: Optional[str] = None) -> None:
         """Forget a decommissioned member entirely."""
+        removed = False
         with _flock(self._lock_path):
             rec = self._read_fresh()
             if rec.get("cores", {}).pop(owner, None) is not None:
                 self._write(rec)
+                removed = True
+        if removed:
+            self.journal.emit("core.state", cause=cause,
+                              epoch=rec["epoch"], owner=owner,
+                              state="removed")
 
 
 class RoutingCache:
@@ -334,26 +357,34 @@ class MigrationEngine:
     #: chaos seam (duck-typed FaultPlane), None when disarmed
     fault_plane = None
 
-    def __init__(self, host, counters=None):
+    def __init__(self, host, counters=None, journal=None):
         # ``host`` is duck-typed (front_end.ShardHost): owner_id, address,
         # placement, table, servers, hb_times, claim_epochs, table_epochs,
         # migrating, _make_server(k)
         self.host = host
         self.counters = (counters if counters is not None
                          else tier_counters("placement"))
+        self.journal = journal if journal is not None else get_journal()
+        self._adopt_cause: Optional[str] = None
 
     # -------------------------------------------------------------- source
 
     def migrate(self, k: int, target_addr: str,
                 adopt: Optional[Callable[[int, str], dict]] = None,
-                on_flip: Optional[Callable] = None) -> dict:
+                on_flip: Optional[Callable] = None,
+                cause: Optional[str] = None) -> dict:
         """Move partition ``k`` from this host to ``target_addr``.
 
         ``adopt(k, from_owner)`` performs the target side; defaults to an
         ``admin_adopt_partition`` RPC against ``target_addr``. ``on_flip``
         (if given) runs after the epoch bump with ``(k, target_addr,
         epoch, server)`` — the front end uses it to push ``fplacement``
-        and drop the partition's live sessions.
+        and drop the partition's live sessions. ``cause`` roots the
+        journal chain (the rebalance actuation or operator command that
+        asked for the move); every phase then links to the previous
+        one, and the cause id crosses to the target over the adopt RPC,
+        so the fleet-merged journal shows one connected chain:
+        cause → seal → fence → checkpoint → adopt → epoch bump → commit.
         """
         host = self.host
         server = host.servers.get(k)
@@ -362,21 +393,28 @@ class MigrationEngine:
         if k in host.migrating:
             raise RuntimeError(f"partition {k} already migrating")
         host.migrating.add(k)
+        jr = self.journal
         try:
             if self.fault_plane is not None:
                 self.fault_plane("placement.pre_fence", k=k)
             # 1. seal: submits bounce from here on (front-end shed nacks)
             server.seal()
+            seal_id = jr.emit("migration.seal", cause=cause, part=k,
+                              target=target_addr)
             # 2. fence seqs: drain queued raw records first, then they are
             # exact — sealed + single-threaded means nothing is in flight
             server.drain()
             fences = server.doc_sequence_numbers()
+            fence_id = jr.emit("migration.fence", cause=seal_id, part=k,
+                               docs=len(fences))
             # 3. checkpoint + flush: the state the target resumes from
             server.checkpoint_all()
             flush = getattr(server.log, "flush", None)
             if flush is not None:
                 flush()
             self.counters.inc("placement.migration.fences")
+            ckpt_id = jr.emit("migration.checkpoint", cause=fence_id,
+                              part=k)
             if self.fault_plane is not None:
                 self.fault_plane("placement.pre_handoff", k=k)
             # stop heartbeating/serving k BEFORE the transfer: the lease
@@ -386,11 +424,16 @@ class MigrationEngine:
             server.revoke()
             # 4. handoff: the target transfers the lease + claims the epoch
             do_adopt = adopt if adopt is not None else self._rpc_adopt
+            self._adopt_cause = ckpt_id
             try:
                 result = do_adopt(k, target_addr)
-            except Exception:
+            except Exception as exc:
+                jr.emit("migration.fail", cause=ckpt_id, part=k,
+                        target=target_addr, error=str(exc))
                 self._reclaim(k)
                 raise
+            finally:
+                self._adopt_cause = None
             if self.fault_plane is not None:
                 # the "source dies during target replay" window: the
                 # target owns the lease + epoch; the source merely fails
@@ -398,6 +441,9 @@ class MigrationEngine:
                 self.fault_plane("placement.post_handoff", k=k)
             epoch = result["epoch"]
             self.counters.inc("placement.migration.committed")
+            jr.emit("migration.commit",
+                    cause=result.get("journal") or ckpt_id, part=k,
+                    target=target_addr, epoch=epoch)
             # 5. flip: push the new route, drop the sealed sessions
             if on_flip is not None:
                 on_flip(k, target_addr, epoch, server)
@@ -423,6 +469,11 @@ class MigrationEngine:
         host_s, _, port_s = target_addr.rpartition(":")
         frame = {"t": "admin_adopt_partition", "k": k,
                  "from_owner": self.host.owner_id}
+        if self._adopt_cause:
+            # the cause id crosses the wire so the TARGET core's journal
+            # links its adopt entry back to the source's checkpoint —
+            # the fleet merge stitches the chain across processes
+            frame["journal_cause"] = self._adopt_cause
         secret = getattr(self.host, "admin_secret", None)
         if secret:
             frame["secret"] = secret
@@ -430,7 +481,8 @@ class MigrationEngine:
 
     # -------------------------------------------------------------- target
 
-    def adopt(self, k: int, from_owner: str) -> dict:
+    def adopt(self, k: int, from_owner: str,
+              cause: Optional[str] = None) -> dict:
         """Target side: take over ``k`` from ``from_owner`` and resume its
         pipeline from the shipped checkpoint + idempotent raw-log tail."""
         host = self.host
@@ -438,14 +490,17 @@ class MigrationEngine:
                                        host.address):
             raise RuntimeError(
                 f"partition {k} not transferable from {from_owner}")
-        epoch = host.table.record_claim(k, host.owner_id, host.address)
+        adopt_id = self.journal.emit("migration.adopt", cause=cause,
+                                     part=k, from_owner=from_owner)
+        epoch = host.table.record_claim(k, host.owner_id, host.address,
+                                        cause=adopt_id)
         host.claim_epochs[k] = epoch
         host.table_epochs[k] = epoch
         server = host._make_server(k)
         host.servers[k] = server
         host.hb_times[k] = time.monotonic()
         self.counters.inc("placement.migration.adopted")
-        return {"epoch": epoch}
+        return {"epoch": epoch, "journal": adopt_id}
 
 
 def admin_rpc(host: str, port: int, frame: dict,

@@ -40,13 +40,14 @@ migration target — the heat scrape doubles as a liveness probe.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
-from ..obs import get_registry
+from ..obs import get_journal, get_registry
 from .placement_plane import (
     CORE_ACTIVE,
     CORE_DRAINED,
@@ -323,10 +324,23 @@ class Rebalancer:
                  heat_reader: Optional[Callable] = None,
                  actuate: Optional[Callable] = None,
                  secret: Optional[str] = None, registry=None,
-                 counters=None):
+                 counters=None, journal=None):
         self.host = host
         self.engine = engine
         self.slo_engine = slo_engine
+        self.journal = journal if journal is not None else get_journal()
+        # injected actuate seams predate the journal-cause thread; only
+        # pass cause= to ones that declare it (or **kwargs)
+        self._actuate_cause_ok = False
+        if actuate is not None:
+            try:
+                params = inspect.signature(actuate).parameters
+                self._actuate_cause_ok = (
+                    "cause" in params
+                    or any(p.kind is p.VAR_KEYWORD
+                           for p in params.values()))
+            except (TypeError, ValueError):
+                pass
         self.tick_s = float(tick_s)
         self.dwell_s = float(dwell_s)
         self.cooldown_s = (float(cooldown_s) if cooldown_s is not None
@@ -428,6 +442,35 @@ class Rebalancer:
             improvement=self.improvement, slo_hot=slo_hot,
             only_source=self.host.owner_id)
         self.last_plan = plan
+        jr = self.journal
+        plan_id = None
+        if plan.moves or plan.suppressed_hysteresis \
+                or plan.suppressed_budget:
+            # decision-time heat snapshot: the journal answers "what did
+            # the planner SEE", which the live metrics can't once the
+            # window rolls (bounded: hottest 16 partitions)
+            hot = sorted(heat.items(), key=lambda kv: -kv[1].load)[:16]
+            snapshot = {str(k): round(h.load, 2) for k, h in hot}
+            if plan.moves:
+                plan_id = jr.emit(
+                    "rebalance.plan",
+                    moves=[{"k": m.k, "src": m.src, "dst": m.dst,
+                            "load": round(m.load, 3)}
+                           for m in plan.moves],
+                    spread_before=round(plan.spread_before, 3),
+                    spread_after=round(plan.spread_after, 3),
+                    slo_hot=slo_hot, heat=snapshot)
+            if plan.suppressed_hysteresis or plan.suppressed_budget:
+                reasons = []
+                if plan.suppressed_hysteresis:
+                    reasons.append("hysteresis")
+                if plan.suppressed_budget:
+                    reasons.append("budget")
+                jr.emit("rebalance.suppressed",
+                        reason="+".join(reasons),
+                        hysteresis=plan.suppressed_hysteresis,
+                        budget=plan.suppressed_budget,
+                        slo_hot=slo_hot, heat=snapshot)
         if plan.moves:
             c.inc("placement.rebalance.plans")
         if plan.suppressed_hysteresis:
@@ -437,11 +480,17 @@ class Rebalancer:
             c.inc("placement.rebalance.suppressed_budget",
                   plan.suppressed_budget)
         for mv in plan.moves:
+            act_id = jr.emit("rebalance.actuate", cause=plan_id,
+                             part=mv.k, src=mv.src, dst=mv.dst,
+                             load=round(mv.load, 3))
             try:
                 if self._actuate_fn is not None:
-                    self._actuate_fn(mv.k, mv.dst_addr)
+                    if self._actuate_cause_ok:
+                        self._actuate_fn(mv.k, mv.dst_addr, cause=act_id)
+                    else:
+                        self._actuate_fn(mv.k, mv.dst_addr)
                 else:
-                    self.engine.migrate(mv.k, mv.dst_addr)
+                    self.engine.migrate(mv.k, mv.dst_addr, cause=act_id)
             except Exception as e:
                 self.last_error = f"{type(e).__name__}: {e}"
                 break

@@ -400,13 +400,23 @@ class ApplierStage(_StageHostBase):
         # "applied" emits are not — the restart replays against a NEWER
         # farm, the skip-by-seq path's hardest case
         self._fault("stage.post_checkpoint")
+        # thread the hoptail across the process boundary: the applier's
+        # last stage/execute wall stamps ride the "applied" record so
+        # the core can fold stage_to_execute into its own registry
+        wave_hops = getattr(self.applier, "last_wave_hops", None)
+        if wave_hops is not None:  # consume: one fold per wave
+            self.applier.last_wave_hops = None
         for topic, offset in self._offsets.items():
             tenant, doc = _doc_of(topic)
             self.save_checkpoint(tenant, doc, {"offset": offset})
-            self.emit({"kind": "applied", "tenant": tenant, "doc": doc,
-                       "applied_seq": max(
-                           self._watermarks.get(topic, 0),
-                           self.applier.applied_seq(tenant, doc))})
+            rec = {"kind": "applied", "tenant": tenant, "doc": doc,
+                   "applied_seq": max(
+                       self._watermarks.get(topic, 0),
+                       self.applier.applied_seq(tenant, doc))}
+            if wave_hops is not None:
+                rec["wave_hops"] = list(wave_hops)
+                wave_hops = None  # one observation per wave, not per doc
+            self.emit(rec)
 
 
 STAGES = {"scribe": ScribeStage, "applier": ApplierStage}

@@ -978,6 +978,9 @@ class TpuDocumentApplier:
         reg.set_gauge("applier.stage.overlap_ratio",
                       self.stage_overlap_seconds / self.stage_seconds,
                       lane=staged.lane)
+        # applier/stage hop: wall-clock stamp at stage completion, the
+        # hoptail's clock — _execute_wave closes the stage→execute leg
+        self._last_stage_wall = time.time()
         if self.fault_plane is not None:
             # chaos seam: wave N+1 staged (popped from the staging dict,
             # device buffers resident) but NOT yet executed — a crash
@@ -1006,7 +1009,21 @@ class TpuDocumentApplier:
             jax.block_until_ready(self._exec_marker)
         dt = time.perf_counter() - t0
         self.exec_seconds += dt
-        self._metrics().inc("applier.exec.seconds", dt, lane=staged.lane)
+        reg = self._metrics()
+        reg.inc("applier.exec.seconds", dt, lane=staged.lane)
+        # applier/execute hop: the dispatch-split leg of the hop
+        # breakdown. Observed directly into the hop family (this wave
+        # never rides a wire hoptail), and retained as last_wave_hops so
+        # a subprocess ApplierStage can thread the stamps over its
+        # backchannel for the parent core's registry.
+        stage_wall = getattr(self, "_last_stage_wall", None)
+        exec_wall = time.time()
+        if stage_wall is not None:
+            ms = (exec_wall - stage_wall) * 1e3
+            reg.observe("obs.hop.ms", ms, pair="stage_to_execute")
+            reg.observe_windowed("obs.hop.window_ms", ms,
+                                 pair="stage_to_execute")
+            self.last_wave_hops = ((stage_wall, exec_wall))
         self.dispatches += 1
         self._dispatches_since_check += 1
         if self.fault_plane is not None:

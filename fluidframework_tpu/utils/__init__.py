@@ -12,8 +12,13 @@ from .telemetry import (  # noqa: F401
     HOP_ACK,
     HOP_ADMIT,
     HOP_DELI,
+    HOP_EXECUTE,
     HOP_FANOUT,
+    HOP_ORDER,
+    HOP_PIPELINE,
     HOP_RELAY,
+    HOP_SHED,
+    HOP_STAGE,
     HOP_SUBMIT,
     HOPS,
     BufferSink,
@@ -21,6 +26,7 @@ from .telemetry import (  # noqa: F401
     PerformanceEvent,
     TelemetryLogger,
     TraceAggregator,
+    count_unknown_hops,
     hop_pair_name,
     percentile,
 )

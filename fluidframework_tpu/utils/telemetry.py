@@ -24,14 +24,20 @@ Sink = Callable[[dict], None]
 
 # --------------------------------------------------------------- hop taxonomy
 #
-# The per-tier trace-hop vocabulary, in pipeline order. Columnar wire
-# frames carry hops as compact (hop id, timestamp) pairs (the binwire
-# hoptail); rec frames carry the (service, action) strings. Both sides
-# map through THIS table — it is the taxonomy's single source of truth
-# — and the breakdown pair names (``submit_to_deli``, ``deli_to_ack``,
+# The per-tier trace-hop vocabulary. Columnar wire frames carry hops
+# as compact (hop id, timestamp) pairs (the binwire hoptail); rec
+# frames carry the (service, action) strings. Both sides map through
+# THIS table — it is the taxonomy's single source of truth — and the
+# breakdown pair names (``submit_to_deli``, ``deli_to_ack``,
 # ``admit_to_deli``, …) derive from the SHORT keys of consecutive
 # PRESENT hops, so the legacy two-pair split falls out as the special
 # case where only client/submit and deli/sequence are stamped.
+#
+# STABILITY: hop ids are WIRE values (hoptail u8, durable replays,
+# mixed-version gateways) — existing ids are FROZEN and new hops are
+# APPENDED, never inserted. Numeric id order therefore stopped
+# matching path order at id 6; the pipeline position lives in
+# HOP_PIPELINE below, and every ordering consumer sorts by that.
 HOPS = (
     ("client", "submit", "submit"),
     ("gateway", "relay", "relay"),
@@ -39,15 +45,26 @@ HOPS = (
     ("deli", "sequence", "deli"),
     ("broadcast", "fanout", "fanout"),
     ("client", "ack", "ack"),
+    # -- appended (PR 14): ids 6+ are newer than some stampers --
+    ("frontend", "shed", "shed"),      # driver parked the op on a shed nack
+    ("applier", "stage", "stage"),     # host half of a dispatch wave
+    ("applier", "execute", "execute"),  # device half of a dispatch wave
 )
 (HOP_SUBMIT, HOP_RELAY, HOP_ADMIT, HOP_DELI, HOP_FANOUT,
- HOP_ACK) = range(len(HOPS))
+ HOP_ACK, HOP_SHED, HOP_STAGE, HOP_EXECUTE) = range(len(HOPS))
 #: hop id → (service, action) — the rec-frame string pair.
 HOP_SERVICE_ACTION = tuple((s, a) for s, a, _ in HOPS)
 #: (service, action) → hop id.
 HOP_ID = {(s, a): i for i, (s, a, _) in enumerate(HOPS)}
 #: hop id → short key used in breakdown pair names.
 HOP_SHORT = tuple(short for _, _, short in HOPS)
+#: Hop ids in PIPELINE order — shed precedes submit (the park happens
+#: before the retry-flush restamps submit), stage/execute sit between
+#: sequencing and fan-out (the applier consumes the sequenced stream).
+HOP_PIPELINE = (HOP_SHED, HOP_SUBMIT, HOP_RELAY, HOP_ADMIT, HOP_DELI,
+                HOP_STAGE, HOP_EXECUTE, HOP_FANOUT, HOP_ACK)
+#: hop id → pipeline position (the sort key for breakdown legs).
+HOP_ORDER = {h: i for i, h in enumerate(HOP_PIPELINE)}
 
 
 def hop_pair_name(a: int, b: int) -> str:
@@ -57,15 +74,35 @@ def hop_pair_name(a: int, b: int) -> str:
 
 def hop_pairs(hops) -> list[tuple[str, float]]:
     """[(hop_id, ts), ...] → [(pair_name, delta_ms), ...] between
-    consecutive PRESENT hops in taxonomy order (unknown ids ignored;
-    a repeated id keeps its last timestamp)."""
+    consecutive PRESENT hops in pipeline order (unknown ids ignored;
+    a repeated id keeps its last timestamp — EXCEPT gateway/relay,
+    where every stamp is kept in arrival order: stacked relay tiers
+    each stamp the same id, so the repeats ARE the relay depth and
+    each inter-tier leg surfaces as a ``relay_to_relay`` pair)."""
     ts_by_id: dict[int, float] = {}
+    relays: list[float] = []
     for i, ts in hops:
-        if 0 <= i < len(HOPS):
+        if not 0 <= i < len(HOPS):
+            continue
+        if i == HOP_RELAY:
+            relays.append(ts)
+        else:
             ts_by_id[i] = ts
-    order = sorted(ts_by_id)
-    return [(hop_pair_name(a, b), (ts_by_id[b] - ts_by_id[a]) * 1e3)
-            for a, b in zip(order, order[1:])]
+    seq: list[tuple[int, float]] = []
+    for h in HOP_PIPELINE:
+        if h == HOP_RELAY:
+            seq.extend((HOP_RELAY, ts) for ts in relays)
+        elif h in ts_by_id:
+            seq.append((h, ts_by_id[h]))
+    return [(hop_pair_name(a, b), (tb - ta) * 1e3)
+            for (a, ta), (b, tb) in zip(seq, seq[1:])]
+
+
+def count_unknown_hops(hops) -> int:
+    """Entries whose id falls outside the taxonomy — a version-skewed
+    stamper. Callers surface the count as ``obs.trace.unknown_hops``
+    (this module sits below obs/, so it cannot reach the registry)."""
+    return sum(1 for i, _ in hops if not 0 <= i < len(HOPS))
 
 
 def percentile(sorted_vals: list[float], p: float) -> float:
@@ -218,6 +255,11 @@ class TraceAggregator:
 
     def __init__(self):
         self._hops: dict[str, list[float]] = defaultdict(list)
+        #: hops dropped for an id outside the taxonomy — a
+        #: version-skewed stamper; surfaced in ``report()`` (and by
+        #: service consumers as ``obs.trace.unknown_hops``) instead of
+        #: vanishing silently.
+        self.unknown_hops = 0
 
     def record(self, msg, ack_time: Optional[float] = None) -> None:
         hops = []
@@ -225,6 +267,8 @@ class TraceAggregator:
             i = HOP_ID.get((hop.service, hop.action))
             if i is not None:
                 hops.append((i, hop.timestamp))
+            else:
+                self.unknown_hops += 1
         self.record_hops(
             hops, ack_time if ack_time is not None else time.time())
 
@@ -238,9 +282,14 @@ class TraceAggregator:
         attribute, so a lone client/submit stamp records nothing.
         """
         known = [(i, ts) for i, ts in hops if 0 <= i < len(HOPS)]
+        self.unknown_hops += len(hops) - len(known)
+        # "actually sequenced" means deli-or-later in PIPELINE order —
+        # appended ids like frontend/shed are numerically past deli but
+        # sit before it on the path, so numeric comparison would lie
+        deli_pos = HOP_ORDER[HOP_DELI]
         if (ack_time is not None
                 and all(i != HOP_ACK for i, _ in known)
-                and any(i >= HOP_DELI for i, _ in known)):
+                and any(HOP_ORDER[i] >= deli_pos for i, _ in known)):
             known.append((HOP_ACK, ack_time))
         for name, ms in hop_pairs(known):
             self._hops[name].append(ms)
@@ -260,4 +309,6 @@ class TraceAggregator:
             out[name] = {"count": len(s),
                          "p50_ms": round(percentile(s, 0.5), 3),
                          "p99_ms": round(percentile(s, 0.99), 3)}
+        if self.unknown_hops:
+            out["unknown_hops"] = {"count": self.unknown_hops}
         return out

@@ -24,6 +24,7 @@ from fluidframework_tpu.utils.contracts import (
 from tools.fluidlint import (
     hygiene,
     jaxpr_check,
+    journal_check,
     layers,
     metrics_check,
     storage_check,
@@ -476,6 +477,85 @@ def test_boot_family_members_pass(tmp_path):
 
 def test_metrics_real_tree_clean():
     assert metrics_check.check_metrics(repo_root=REPO) == []
+
+
+def test_journal_family_lock_caught(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('obs.journal.writes')\n")  # not a member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 \
+        and 'locked "obs.journal.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "obs.journal.entries" in vs[0].message
+
+
+# ---------------------------------------------------------- journal-kind
+
+def _journal_tree(tmp_path, mod_src, kinds_src=None):
+    """A fake repo with an obs/journal.py KINDS table and one module."""
+    pkg = tmp_path / "fluidframework_tpu"
+    obs = pkg / "obs"
+    obs.mkdir(parents=True)
+    (obs / "journal.py").write_text(
+        kinds_src if kinds_src is not None else
+        'KINDS = {"epoch.bump": "x", "migration.seal": "x",\n'
+        '         "core.start": "x", "core.recover": "x"}\n')
+    path = pkg / "mod.py"
+    path.write_text(mod_src)
+    return str(path)
+
+
+def test_journal_undeclared_kind_caught(tmp_path):
+    path = _journal_tree(
+        tmp_path,
+        "def f(jr):\n"
+        "    jr.emit('migration.sealed', part=1)\n")  # typo'd kind
+    kinds = journal_check.load_kinds(str(tmp_path))
+    vs = journal_check.check_file(path, kinds, repo_root=str(tmp_path))
+    assert len(vs) == 1 and "migration.sealed" in vs[0].message, \
+        [str(v) for v in vs]
+
+
+def test_journal_kind_kwarg_and_ifexp_checked(tmp_path):
+    # kind= keyword and both arms of a conditional are all literals
+    path = _journal_tree(
+        tmp_path,
+        "def f(jr, n):\n"
+        "    jr.emit(kind='lease.claim')\n"  # undeclared in the fake table
+        "    jr.emit('core.recover' if n else 'core.stop')\n")
+    kinds = journal_check.load_kinds(str(tmp_path))
+    vs = journal_check.check_file(path, kinds, repo_root=str(tmp_path))
+    msgs = [v.message for v in vs]
+    assert len(vs) == 2, msgs
+    assert any("lease.claim" in m for m in msgs)
+    assert any("core.stop" in m for m in msgs)
+
+
+def test_journal_declared_kinds_and_dict_emits_pass(tmp_path):
+    path = _journal_tree(
+        tmp_path,
+        "def f(jr, stage):\n"
+        "    jr.emit('epoch.bump', part=0)\n"
+        "    jr.emit('migration.seal', cause=None)\n"
+        "    stage.emit({'kind': 'applied'})\n"  # backchannel: out of scope
+        "    jr.emit(computed_kind())\n")  # computed: out of scope
+    kinds = journal_check.load_kinds(str(tmp_path))
+    assert journal_check.check_file(path, kinds,
+                                    repo_root=str(tmp_path)) == []
+
+
+def test_journal_nonliteral_kinds_table_caught(tmp_path):
+    _journal_tree(tmp_path, "x = 1\n",
+                  kinds_src="KINDS = dict(make_kinds())\n")
+    vs = journal_check.check_journal_kinds(repo_root=str(tmp_path))
+    assert len(vs) == 1 and "pure dict literal" in vs[0].message, \
+        [str(v) for v in vs]
+
+
+def test_journal_real_tree_clean():
+    assert journal_check.check_journal_kinds(repo_root=REPO) == []
 
 
 # ------------------------------------------------------------------- CLI

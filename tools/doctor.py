@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Bundle triage: read an ``admin bundle`` directory and print what an
+on-call operator needs first.
+
+    python tools/doctor.py BUNDLE_DIR
+
+The bundle (fluidframework_tpu/admin.py ``bundle --out DIR``) holds the
+fleet's debug surface frozen at capture time: ``placement.json`` (epoch
+table + membership), and per core under ``cores/<owner>/`` the metrics
+scrape (``scrape.prom``), windowed history rings (``history.json``),
+journal tail (``journal.jsonl``), SLO status (``slo.json``), rebalancer
+status (``rebalance.json``) and any flight dumps that were readable at
+capture (``flight/``). The doctor joins these into a triage report:
+
+1. fleet summary — cores, states, capture errors;
+2. hop-pair latency table — the slowest legs of the pipeline by mean,
+   from each core's scrape (where the tail latency actually lives:
+   relay depth, shed parking, device dispatch);
+3. SLO burn — specs not in ``ok``, with their windowed p99 vs budget;
+4. recent migrations — each commit/fail with its CAUSAL CHAIN walked
+   root-first through the merged fleet journal (operator command or
+   rebalance plan → seal → fence → checkpoint → adopt → epoch bump);
+5. anomalies — orphaned partitions (owner not in the membership),
+   draining/drained cores still owning partitions, migration failures,
+   rebalance suppression storms, version-skew hop drops
+   (``obs.trace.unknown_hops``), disarmed journals, journal write
+   errors.
+
+Read-only; exit 0 with "healthy" when nothing needs attention, exit 1
+when any anomaly or active SLO burn was found (so a CI gate can assert
+a bundle is quiet — or assert it ISN'T after a forced incident).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from fluidframework_tpu.obs.journal import (  # noqa: E402
+    causal_chain,
+    merge_entries,
+)
+
+#: scrape lines for the hop summaries: fluid_obs_hop_ms_count{...} N
+_SCRAPE_RE = re.compile(
+    r'^fluid_obs_hop_ms_(count|sum)\{([^}]*)\}\s+([0-9.eE+-]+)')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+#: consecutive rebalance.suppressed entries (no plan between) that
+#: count as a storm — the loop wants to move but can't
+STORM_THRESHOLD = 10
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_journal(path) -> list:
+    out = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict) and "kind" in e:
+                out.append(e)
+    return out
+
+
+def _hop_table(scrape_text: str) -> dict:
+    """pair → (count, sum_ms) from one core's Prometheus scrape."""
+    acc: dict = {}
+    for line in scrape_text.splitlines():
+        m = _SCRAPE_RE.match(line)
+        if m is None:
+            continue
+        stat, labels_s, val = m.group(1), m.group(2), float(m.group(3))
+        labels = dict(_LABEL_RE.findall(labels_s))
+        pair = labels.get("pair")
+        if pair is None:
+            continue
+        count, total = acc.get(pair, (0.0, 0.0))
+        if stat == "count":
+            count += val
+        else:
+            total += val
+        acc[pair] = (count, total)
+    return acc
+
+
+def _scrape_counter(scrape_text: str, name: str) -> float:
+    total = 0.0
+    pat = re.compile(r"^" + re.escape(name) + r'(?:\{[^}]*\})?\s+'
+                     r"([0-9.eE+-]+)")
+    for line in scrape_text.splitlines():
+        m = pat.match(line)
+        if m is not None:
+            total += float(m.group(1))
+    return total
+
+
+def _fmt_entry(e: dict) -> str:
+    labels = " ".join(f"{k}={v}" for k, v in
+                      sorted((e.get("labels") or {}).items()))
+    epoch = e.get("epoch")
+    return (f"e{epoch if epoch is not None else '-'} "
+            f"[{e.get('id')}] {e.get('kind')}  {labels}")
+
+
+def diagnose(bundle_dir: str) -> dict:
+    """Parse the bundle into a triage dict (the printable report's
+    data source — tests and the net_smoke gate assert on this)."""
+    report: dict = {"cores": {}, "hop_pairs": [], "slo_burn": [],
+                    "migrations": [], "anomalies": []}
+    anomalies = report["anomalies"]
+    manifest = _load_json(os.path.join(bundle_dir, "manifest.json")) or {}
+    placement = _load_json(os.path.join(bundle_dir, "placement.json"))
+    cores_dir = os.path.join(bundle_dir, "cores")
+    owners = (sorted(os.listdir(cores_dir))
+              if os.path.isdir(cores_dir) else [])
+
+    hop_acc: dict = {}
+    per_core_journals = []
+    for owner in owners:
+        cdir = os.path.join(cores_dir, owner)
+        row = dict(manifest.get("cores", {}).get(owner, {}))
+        report["cores"][owner] = row
+        if row.get("error"):
+            anomalies.append(
+                f"core {owner}: capture error ({row['error']}) — "
+                "unreachable or mid-restart at bundle time")
+        scrape_path = os.path.join(cdir, "scrape.prom")
+        try:
+            with open(scrape_path) as f:
+                scrape = f.read()
+        except OSError:
+            scrape = ""
+        for pair, (count, total) in _hop_table(scrape).items():
+            c, t = hop_acc.get(pair, (0.0, 0.0))
+            hop_acc[pair] = (c + count, t + total)
+        unknown = _scrape_counter(scrape, "fluid_obs_trace_unknown_hops")
+        if unknown:
+            anomalies.append(
+                f"core {owner}: {int(unknown)} hop stamp(s) outside "
+                "this build's taxonomy (version-skewed client?) — "
+                "the breakdown is missing legs")
+        journal = _load_journal(os.path.join(cdir, "journal.jsonl"))
+        per_core_journals.append(journal)
+        if row.get("journal_armed") is False and not journal:
+            anomalies.append(
+                f"core {owner}: journal disarmed — no audit trail "
+                "from this core")
+        err = sum(1 for e in journal if e.get("kind") == "core.recover")
+        if err:
+            row["recoveries"] = err
+        slo = _load_json(os.path.join(cdir, "slo.json")) or {}
+        for r in slo.get("slos", []):
+            if r.get("state") != "ok":
+                report["slo_burn"].append({"core": owner, **r})
+        # suppression storm: longest run of rebalance.suppressed
+        # without an actionable plan breaking it
+        run = best = 0
+        for e in journal:
+            kind = e.get("kind", "")
+            if kind == "rebalance.suppressed":
+                run += 1
+                best = max(best, run)
+            elif kind == "rebalance.plan":
+                run = 0
+        if best >= STORM_THRESHOLD:
+            anomalies.append(
+                f"core {owner}: rebalance suppression storm ({best} "
+                "consecutive suppressed ticks) — the loop wants to "
+                "move but hysteresis/budget keeps refusing; check "
+                "dwell/budget settings vs the heat imbalance")
+
+    report["hop_pairs"] = sorted(
+        ((pair, count, total / count if count else 0.0, total)
+         for pair, (count, total) in hop_acc.items()),
+        key=lambda r: -r[3])
+
+    merged = merge_entries(per_core_journals)
+    report["journal_merged"] = merged
+    for e in merged:
+        if e.get("kind") in ("migration.commit", "migration.fail"):
+            report["migrations"].append(
+                {"entry": e, "chain": causal_chain(merged, e["id"])})
+            if e["kind"] == "migration.fail":
+                anomalies.append(
+                    f"migration of part "
+                    f"{(e.get('labels') or {}).get('part')} FAILED on "
+                    f"{e.get('core')}: "
+                    f"{(e.get('labels') or {}).get('error')}")
+    report["migrations"] = report["migrations"][-5:]
+
+    if placement is not None:
+        member_states = {owner: row.get("state")
+                         for owner, row in
+                         (placement.get("cores") or {}).items()}
+        owned_by: dict = {}
+        for k, part in (placement.get("parts") or {}).items():
+            owned_by.setdefault(part.get("owner"), []).append(k)
+            if member_states and part.get("owner") not in member_states:
+                anomalies.append(
+                    f"part {k}: owner {part.get('owner')} is not in "
+                    "the core membership — orphaned routing entry "
+                    "(stale lease / dead core?)")
+        for owner, state in member_states.items():
+            if state in ("draining", "drained") and owned_by.get(owner):
+                anomalies.append(
+                    f"core {owner} is {state} but still owns parts "
+                    f"{sorted(owned_by[owner])} — evacuation stuck?")
+    return report
+
+
+def print_report(report: dict) -> None:
+    print("== fleet ==")
+    for owner, row in sorted(report["cores"].items()):
+        extra = ""
+        if row.get("recoveries"):
+            extra += f"  recoveries={row['recoveries']}"
+        if row.get("error"):
+            extra += "  CAPTURE-ERROR"
+        print(f"  core {owner} @ {row.get('addr', '?')}"
+              f"  journal={'armed' if row.get('journal_armed') else 'off'}"
+              f"{extra}")
+    print("\n== slowest hop pairs (fleet, by total ms) ==")
+    if not report["hop_pairs"]:
+        print("  (no hop observations in any scrape)")
+    for pair, count, mean, total in report["hop_pairs"][:8]:
+        print(f"  {pair:<22} n={int(count):<8} mean {mean:8.3f} ms  "
+              f"total {total:10.1f} ms")
+    print("\n== SLO burn ==")
+    if not report["slo_burn"]:
+        print("  all specs ok")
+    for r in report["slo_burn"]:
+        print(f"  [{r['state'].upper()}] {r['slo']} on {r['core']}: "
+              f"p99 {r['p99_ms']}ms / budget {r['budget_ms']}ms "
+              f"(burn {r['burn']}/{r['burn_ticks']})")
+    print("\n== recent migrations (causal chains, root first) ==")
+    if not report["migrations"]:
+        print("  none in the journal window")
+    for m in report["migrations"]:
+        print(f"  {_fmt_entry(m['entry'])}")
+        for link in m["chain"]:
+            print(f"    {_fmt_entry(link)}")
+    print("\n== anomalies ==")
+    if not report["anomalies"]:
+        print("  none — healthy")
+    for a in report["anomalies"]:
+        print(f"  ! {a}")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    bundle_dir = argv[0]
+    if not os.path.isdir(bundle_dir):
+        print(f"not a bundle directory: {bundle_dir}")
+        return 2
+    report = diagnose(bundle_dir)
+    print_report(report)
+    return 1 if report["anomalies"] or report["slo_burn"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,12 +9,13 @@ import argparse
 import os
 import sys
 
-PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name", "storage")
+PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name", "storage",
+          "journal-kind")
 
 
 def run(passes, repo_root: str) -> list:
-    from . import (hygiene, jaxpr_check, layers, metrics_check,
-                   storage_check, wire_check)
+    from . import (hygiene, jaxpr_check, journal_check, layers,
+                   metrics_check, storage_check, wire_check)
 
     violations = []
     if "layers" in passes:
@@ -31,6 +32,9 @@ def run(passes, repo_root: str) -> list:
         violations += metrics_check.check_metrics(repo_root=repo_root)
     if "storage" in passes:
         violations += storage_check.check_storage(repo_root=repo_root)
+    if "journal-kind" in passes:
+        violations += journal_check.check_journal_kinds(
+            repo_root=repo_root)
     return violations
 
 

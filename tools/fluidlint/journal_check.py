@@ -1,0 +1,142 @@
+"""Journal-kind lint: the audit journal's closed vocabulary.
+
+``obs/journal.py`` declares every control-plane event kind in its
+``KINDS`` table — the entry schema's contract surface: ``admin journal
+--kind`` filters by prefix, the net-smoke migration gate asserts exact
+chains, and tools/doctor.py pattern-matches kinds for its triage rules.
+An ``emit("migration.sealed", ...)`` typo would journal fine at runtime
+on a *disarmed* journal (emit short-circuits before validation) and
+only explode in production with the journal armed — precisely the
+environment where the audit trail matters most.
+
+This pass closes the loop statically:
+
+- parse the ``KINDS`` dict literal out of ``obs/journal.py`` (it must
+  STAY a pure literal — a computed table would be invisible here, so
+  that too is a violation);
+- walk every ``*.emit(...)`` call in the library package whose first
+  argument (or ``kind=`` keyword) is a string literal — including both
+  arms of a conditional expression like
+  ``emit("core.recover" if seq else "core.start")`` — and require each
+  literal to be a declared kind.
+
+Stage backchannel ``emit({dict})`` calls and computed kinds are out of
+scope (only literals are checkable), mirroring the metric-name pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from .report import Violation
+
+#: Swept directories (repo-relative), same scope as the metric pass.
+JOURNAL_ROOTS = ("fluidframework_tpu",)
+
+#: The declaring module (repo-relative).
+KINDS_HOME = os.path.join("fluidframework_tpu", "obs", "journal.py")
+
+
+def load_kinds(repo_root: Optional[str] = None) -> Optional[frozenset]:
+    """The declared kind set, or None when the KINDS table is missing
+    or not a pure literal (reported as a violation by the caller)."""
+    repo_root = repo_root or _repo_root()
+    path = os.path.join(repo_root, KINDS_HOME)
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "KINDS"):
+            try:
+                kinds = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            if isinstance(kinds, dict):
+                return frozenset(kinds)
+            return None
+    return None
+
+
+def _literal_kinds(node: ast.expr) -> Iterable[str]:
+    """String literals reachable as the kind argument: a plain constant
+    or either arm of a conditional expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, ast.IfExp):
+        yield from _literal_kinds(node.body)
+        yield from _literal_kinds(node.orelse)
+
+
+def check_file(path: str, kinds: frozenset,
+               repo_root: Optional[str] = None) -> list[Violation]:
+    repo_root = repo_root or _repo_root()
+    rel = os.path.relpath(path, repo_root)
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return []  # the hygiene pass reports syntax errors
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "emit"):
+            continue
+        kind_arg = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                kind_arg = kw.value
+        if kind_arg is None:
+            continue
+        for kind in _literal_kinds(kind_arg):
+            if kind not in kinds:
+                out.append(Violation(
+                    pass_name="journal-kind", path=rel,
+                    line=node.lineno,
+                    message=f'journal kind "{kind}" is not declared in '
+                            "obs.journal.KINDS (the closed registry "
+                            "admin journal / doctor triage key on)",
+                    suggestion="fix the typo, or declare the new kind "
+                               "in KINDS in the same change"))
+    return out
+
+
+def check_journal_kinds(repo_root: Optional[str] = None,
+                        roots: tuple = JOURNAL_ROOTS) -> list[Violation]:
+    repo_root = repo_root or _repo_root()
+    kinds = load_kinds(repo_root)
+    if kinds is None:
+        return [Violation(
+            pass_name="journal-kind", path=KINDS_HOME, line=1,
+            message="KINDS is missing or not a pure dict literal — the "
+                    "journal-kind lint cannot read the registry",
+            suggestion="keep KINDS a literal dict of str -> str")]
+    out: list[Violation] = []
+    for r in roots:
+        root = os.path.join(repo_root, r)
+        if not os.path.isdir(root):
+            continue
+        for path in _py_files(root):
+            out.extend(check_file(path, kinds, repo_root))
+    return out
+
+
+def _py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build", "fixtures")]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _repo_root() -> str:
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))

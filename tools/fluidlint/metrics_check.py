@@ -122,6 +122,13 @@ LOCKED_FAMILIES = {
                             "presence.lane.flushes",
                             "presence.lane.delivered"}),
     "session.readonly.": frozenset({"session.readonly.connects"}),
+    # the control-plane audit journal's own health counters: the bench
+    # journal A/B and the doctor's write-error triage key on these
+    # exact names (obs/journal.py)
+    "obs.journal.": frozenset({"obs.journal.entries",
+                               "obs.journal.bytes",
+                               "obs.journal.errors",
+                               "obs.journal.rotations"}),
 }
 
 

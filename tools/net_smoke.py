@@ -33,9 +33,13 @@ amortization points of the socket tier (see ARCHITECTURE.md
   converge once shedding is disarmed;
 - a forced live migration under traffic (two sharded core processes +
   a gateway, ``admin_migrate_doc`` fired mid-stream): every submitted
-  op must ack exactly once (zero lost), and the source core's
+  op must ack exactly once (zero lost), the source core's
   ``placement.migration.committed`` / ``placement.epoch.bumps``
-  counters must be nonzero;
+  counters must be nonzero, the fleet-merged audit journal must show
+  the move's CAUSALLY-LINKED chain (operator command → seal → fence →
+  checkpoint → adopt → commit, crossing both cores), and an ``admin
+  bundle`` of the fleet must be parseable by tools/doctor.py with the
+  migration visible in its triage;
 - a 2-level relay tree (core ← gw1 ← gw2) with read-only leaf
   subscribers — ``fanout.relay.splices`` must rise at BOTH levels,
   ``presence.lane.coalesced`` and ``session.readonly.connects`` must
@@ -186,11 +190,77 @@ def migration_gate() -> dict:
                 f"migration gate: {len(lost)} edit(s) lost or duplicated "
                 f"across the flip (first: {lost[:5]})")
         counters = place["counters"]
+
+        # journal gate: both cores run with --shard-dir, so their audit
+        # journals armed automatically; the fleet merge must contain the
+        # forced move's causal chain, crossing source AND target
+        from fluidframework_tpu.obs.journal import (
+            causal_chain,
+            merge_entries,
+        )
+
+        per_core = []
+        for p in core_ports:
+            t = _Transport("127.0.0.1", p, timeout=10.0)
+            try:
+                j = t.request({"t": "admin_journal", "n": 1000})["journal"]
+                if not j.get("armed"):
+                    raise AssertionError(
+                        f"journal gate: core on :{p} reports a disarmed "
+                        "journal despite --shard-dir")
+                per_core.append(j["entries"])
+            finally:
+                t.close()
+        merged = merge_entries(per_core)
+        commits = [e for e in merged if e["kind"] == "migration.commit"]
+        if not commits:
+            raise AssertionError(
+                "journal gate: no migration.commit entry in the fleet "
+                "journal after the forced move")
+        chain = causal_chain(merged, commits[-1]["id"])
+        kinds = [e["kind"] for e in chain]
+        for want in ("operator.command", "migration.seal",
+                     "migration.fence", "migration.checkpoint",
+                     "migration.adopt", "migration.commit"):
+            if want not in kinds:
+                raise AssertionError(
+                    f"journal gate: {want} missing from the causal "
+                    f"chain (got {kinds})")
+        if len({e["core"] for e in chain}) < 2:
+            raise AssertionError(
+                "journal gate: the chain never crossed cores — the "
+                "adopt RPC dropped the journal_cause link "
+                f"(chain cores: {sorted({e['core'] for e in chain})})")
+
+        # bundle gate: capture the fleet's debug surface and triage it
+        # with the doctor — the forced move must be visible
+        import subprocess
+
+        from tools.doctor import diagnose
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        bundle_dir = os.path.join(shard_dir, "bundle")
+        out = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.admin",
+             "--port", str(src_port), "bundle", "--out", bundle_dir],
+            capture_output=True, text=True, cwd=repo, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if out.returncode != 0:
+            raise AssertionError(
+                f"bundle gate: admin bundle failed:\n{out.stderr}")
+        rep = diagnose(bundle_dir)
+        if not rep["migrations"]:
+            raise AssertionError(
+                "bundle gate: tools/doctor.py found no migrations in "
+                "the captured bundle")
+
         return {
             "placement.migration.committed": counters.get(
                 "placement.migration.committed", 0),
             "placement.epoch.bumps": counters.get(
                 "placement.epoch.bumps", 0),
+            "obs.journal.chain_links": len(chain),
+            "doctor.bundle_migrations": len(rep["migrations"]),
         }
     finally:
         for cont in (writer, reader):
