@@ -385,6 +385,38 @@ def _bundle(args) -> int:
         except (OSError, ValueError, RuntimeError) as e:
             row["error"] = str(e)
             print(f"# core {owner} @ {addr} partially captured: {e}")
+    # static-contract status of the build that captured the bundle:
+    # fluidlint --json at the repo root, so a triage reads lint state
+    # (including which concurrency waivers are in force) next to the
+    # journal and metrics. Deployed captures without the repo checkout
+    # just skip it — doctor treats a missing lint.json as "not captured".
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    if os.path.isdir(os.path.join(repo_root, "tools", "fluidlint")):
+        import subprocess
+        import sys as _sys
+
+        # every pass except jaxpr: tracing the kernels costs ~20 s and
+        # an incident-time capture should not — the jaxpr contracts
+        # can't drift without a code change CI already gated anyway
+        passes = [a for p in ("layers", "wire", "hygiene",
+                              "metric-name", "storage", "journal-kind",
+                              "concurrency")
+                  for a in ("--pass", p)]
+        try:
+            r = subprocess.run(
+                [_sys.executable, "-m", "tools.fluidlint", "--json",
+                 *passes],
+                cwd=repo_root, capture_output=True, text=True,
+                timeout=120,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            lint = json.loads(r.stdout)
+        except (OSError, ValueError, subprocess.TimeoutExpired) as e:
+            print(f"# lint capture skipped: {e}")
+        else:
+            with open(os.path.join(out, "lint.json"), "w") as f:
+                json.dump(lint, f, indent=2)
+            manifest["lint_clean"] = lint.get("clean")
     with open(os.path.join(out, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     print(f"bundle written to {out} ({len(cores)} core(s)); triage "

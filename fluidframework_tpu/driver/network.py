@@ -34,6 +34,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..obs import tier_counters
+from ..utils.affinity import blocking
 from ..protocol import binwire
 from ..protocol.messages import MessageType, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
@@ -176,6 +177,7 @@ class _Transport:
         """Send a frame with a request id; block for the matching reply."""
         return self.request_rid(frame)[1]
 
+    @blocking("parks the calling thread on a condition variable until the reply frame or timeout")
     def request_rid(self, frame: dict) -> tuple[int, dict]:
         """Like :meth:`request` but also returns the rid, so callers can
         collect rid-tagged binary pushes (:meth:`take_blocks`)."""
@@ -315,7 +317,11 @@ class _Transport:
         self._push_handlers[t] = handler
 
     def close(self) -> None:
-        self._closed = True
+        # under the cv: a requester blocked in wait_for must observe the
+        # flag and wake now, not when the reader thread happens to die
+        with self._pending_cv:
+            self._closed = True
+            self._pending_cv.notify_all()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:

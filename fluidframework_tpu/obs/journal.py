@@ -46,6 +46,7 @@ import io
 import json
 import os
 import threading
+from ..utils.affinity import any_thread
 import time
 from typing import Callable, Iterable, Optional
 
@@ -141,6 +142,7 @@ class Journal:
             self._registry = get_registry()
         return self._registry
 
+    @any_thread
     def emit(self, kind: str, cause: Optional[str] = None,
              epoch: Optional[int] = None, **labels) -> Optional[str]:
         """Append one entry; returns its id (the cause link for

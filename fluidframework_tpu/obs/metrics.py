@@ -37,6 +37,7 @@ import time
 import weakref
 from typing import Optional
 
+from ..utils.affinity import holds_lock
 from ..utils.telemetry import Counters, percentile
 
 #: Distinct label sets allowed per metric name before overflow.
@@ -266,9 +267,11 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------ write API
 
+    @holds_lock("MetricsRegistry._lock")
     def _labelset(self, table: dict, name: str, labels: dict) -> tuple:
         """The bounded label key for (name, labels) — the overflow
-        bucket once the name's cardinality budget is spent."""
+        bucket once the name's cardinality budget is spent. Caller must
+        hold ``self._lock`` (every public writer does)."""
         key = tuple(sorted(labels.items()))
         series = table.setdefault(name, {})
         if key not in series and len(series) >= self._max_series:

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .flight import get_recorder
+from ..utils.affinity import ticker_thread
 from .journal import get_journal
 from .metrics import get_registry
 
@@ -190,6 +191,7 @@ class SloEngine:
             self._thread.start()
         return self
 
+    @ticker_thread("slo")
     def _run(self) -> None:
         while not self._stop.wait(self.tick_s):
             try:

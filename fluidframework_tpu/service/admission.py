@@ -38,6 +38,7 @@ import time
 from typing import Callable, Optional
 
 from ..obs import get_registry
+from ..utils.affinity import loop_only
 
 #: Bounds for the retry_after_ms hint handed to shed clients.
 RETRY_AFTER_MIN_MS = 25
@@ -118,6 +119,7 @@ class AdmissionController:
         return (self.shedding and eng is not None
                 and bool(eng.shed_signal))
 
+    @loop_only("core")
     def check(self, conn, n: int, first_cseq: int,
               now: Optional[float] = None) -> float:
         """Admission verdict for a boxcar of ``n`` ops starting at

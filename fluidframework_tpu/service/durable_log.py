@@ -36,6 +36,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..native.oplog import NativeOpLog
+from ..utils.affinity import blocking
 from ..obs.metrics import tier_counters
 from ..protocol import binwire
 from .local_log import OrderedLogBase
@@ -411,6 +412,7 @@ class DurableLog(OrderedLogBase):
         """Refresh ONE topic from disk; returns its record count."""
         return self._refresh_one(topic)
 
+    @blocking("mmap page-cache flush (PR 6; PR 11 made it per-batch) — bounded but off the async fast path")
     def flush(self) -> None:
         self._log.flush()
 
@@ -595,6 +597,7 @@ class DurableLog(OrderedLogBase):
 
     # ------------------------------------------------------------- admin
 
+    @blocking("msync to stable storage — the slow durability barrier, checkpoint/teardown only")
     def sync(self) -> None:
         self._log.sync()
 

@@ -61,6 +61,7 @@ import time
 from typing import Any, Optional
 
 from ..obs import get_journal, get_recorder, get_registry, tier_counters
+from ..utils.affinity import loop_only, ticker_thread
 from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType, Signal, TraceHop
 from ..protocol.serialization import message_from_dict, message_to_dict
@@ -368,6 +369,7 @@ class _ClientSession:
         self.front.presence.subscribe(topic, on_presence)
         self._presence.append((topic, on_presence))
 
+    @loop_only("core")
     def handle(self, frame: dict) -> None:
         t = frame.get("t")
         rid = frame.get("rid")
@@ -1002,6 +1004,26 @@ class _ClientSession:
                 "id": storage.upload_summary(frame["summary"],
                                              frame.get("parent"))})
 
+    def _reply_offloop(self, rid, work, reply) -> None:
+        """Run ``work()`` on the default executor and push
+        ``reply(result)`` from the future's done-callback, which asyncio
+        runs back on the loop thread — so a slow fan-out (peer socket
+        dials with multi-second timeouts) never stalls the event loop
+        the way a synchronous call from ``handle()`` would. Failures get
+        the same error frame the dispatcher's wrapper would have sent."""
+        fut = self._loop.run_in_executor(None, work)
+
+        def _done(f) -> None:
+            try:
+                reply(f.result())
+            except Exception as e:  # noqa: BLE001 — report, don't kill the loop
+                self.front.logger.error("frame_error", frame_type="admin",
+                                        message=str(e))
+                self.push("error", {"rid": rid, "message": str(e)})
+
+        fut.add_done_callback(_done)
+
+    @loop_only("core")
     def _handle_admin(self, t: str, frame: dict, rid) -> None:
         """Management surface (ref: server/admin + riddler's
         tenantManager REST): per-doc pipeline status, doc listing, and
@@ -1132,17 +1154,7 @@ class _ClientSession:
             rec = sh.table.read()
             from ..obs import tier_snapshot
 
-            if frame.get("fleet"):
-                # fleet totals: this core's snapshot summed with every
-                # reachable peer's (admin_tier_snapshot fan-out) — the
-                # operator sees migrations the WHOLE loop issued, not
-                # just the local lane's
-                counters = front._fleet_placement_counters(rec)
-            else:
-                snap = tier_snapshot("placement")
-                counters = {name: v for name, v in snap.items()
-                            if name.startswith("placement.")}
-            self.push("admin", {"rid": rid, "placement": {
+            placement = {
                 "owner": sh.owner_id,
                 "address": sh.address,
                 "epoch": rec["epoch"],
@@ -1150,8 +1162,27 @@ class _ClientSession:
                 "cores": rec.get("cores", {}),
                 "owned": sorted(sh.servers),
                 "leases": sh.placement.table(),
-                "counters": counters,
-            }})
+                "counters": None,
+            }
+            if frame.get("fleet"):
+                # fleet totals: this core's snapshot summed with every
+                # reachable peer's (admin_tier_snapshot fan-out) — the
+                # operator sees migrations the WHOLE loop issued, not
+                # just the local lane's. Each peer is a synchronous
+                # socket dial with a multi-second timeout, so the
+                # fan-out runs off-loop and the reply is pushed from
+                # the done-callback.
+                self._reply_offloop(
+                    rid, lambda: front._fleet_placement_counters(rec),
+                    lambda counters: self.push("admin", {
+                        "rid": rid,
+                        "placement": dict(placement,
+                                          counters=counters)}))
+                return
+            snap = tier_snapshot("placement")
+            placement["counters"] = {name: v for name, v in snap.items()
+                                     if name.startswith("placement.")}
+            self.push("admin", {"rid": rid, "placement": placement})
         elif t == "admin_migrate_doc":
             # live migration trigger: move the doc's PARTITION to the
             # named core. Synchronous ON the event loop by design — the
@@ -1220,8 +1251,17 @@ class _ClientSession:
             status = (reb.status() if reb is not None
                       else {"armed": False})
             if frame.get("fleet") and front.shard_host is not None:
-                status["fleet_counters"] = front._fleet_placement_counters(
-                    front.shard_host.table.read())
+                # same off-loop treatment as admin_placement: the peer
+                # fan-out must not stall the loop
+                table_rec = front.shard_host.table.read()
+                self._reply_offloop(
+                    rid,
+                    lambda: front._fleet_placement_counters(table_rec),
+                    lambda counters: self.push("admin", {
+                        "rid": rid,
+                        "rebalance": dict(status,
+                                          fleet_counters=counters)}))
+                return
             self.push("admin", {"rid": rid, "rebalance": status})
         elif t == "admin_placement_drain":
             # mark a member draining: every rebalancer tick on that core
@@ -1705,6 +1745,7 @@ class NetworkFrontEnd:
             "budget": budget, "improvement": improvement}
         return self
 
+    @ticker_thread("rebalancer")
     def _rebalance_actuate(self, k: int, target_addr: str,
                            cause: Optional[str] = None) -> None:
         """Actuation seam for the rebalancer's ticker THREAD: a loopback

@@ -55,6 +55,7 @@ import time
 from typing import Optional
 
 from ..obs import get_recorder, tier_counters
+from ..utils.affinity import loop_only
 from ..protocol import binwire
 from ..utils.telemetry import HOP_RELAY
 from .front_end import (_BULK_FRAMES, _encode_frame, _frame_buffered,
@@ -551,6 +552,7 @@ class Gateway:
                     fut.set_exception(
                         ConnectionError("core disconnected"))
 
+    @loop_only("gateway")
     def _dispatch_upstream_binary(self, body: bytes) -> None:
         """Relay a binary fops batch or fpresence flush: downstream
         gateway LINKS get the backbone bytes VERBATIM (topic intact —
@@ -596,6 +598,7 @@ class Gateway:
         return _encode_frame(
             {"t": "ops", "msgs": [message_to_dict(m) for m in msgs]})
 
+    @loop_only("gateway")
     def _dispatch_upstream(self, frame: dict) -> None:
         rid = frame.get("rid")
         if rid is not None:

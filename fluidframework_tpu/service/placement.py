@@ -29,6 +29,8 @@ import tempfile
 import time
 from typing import Optional
 
+from ..utils.affinity import holds_lock
+
 DEFAULT_TTL_S = 3.0
 
 
@@ -76,6 +78,7 @@ class PlacementDir:
                 os.close(fd)
         return held()
 
+    @holds_lock("partition_claim_flock")
     def try_claim(self, k: int, owner_id: str, address: str) -> bool:
         """Claim partition ``k`` if it is unowned or its lease is stale.
         Returns True when this owner holds the lease afterwards."""
@@ -91,6 +94,7 @@ class PlacementDir:
             os.replace(tmp, self._path(k))
             return True
 
+    @holds_lock("partition_claim_flock")
     def heartbeat(self, k: int, owner_id: str) -> bool:
         """Refresh the lease mtime; returns False if the lease was lost
         (taken over) — the caller must stop serving the partition.
@@ -107,6 +111,7 @@ class PlacementDir:
             os.utime(self._path(k))
             return True
 
+    @holds_lock("partition_claim_flock")
     def transfer(self, k: int, from_owner: str, to_owner: str,
                  to_address: str) -> bool:
         """Migration handoff: atomically rewrite ``k``'s lease from
@@ -127,6 +132,7 @@ class PlacementDir:
             os.replace(tmp, self._path(k))
             return True
 
+    @holds_lock("partition_claim_flock")
     def release(self, k: int, owner_id: str) -> None:
         # same flock as try_claim/heartbeat: a release racing a takeover
         # must not unlink the NEW owner's lease after a stale read

@@ -43,6 +43,7 @@ import time
 from typing import Callable, Optional
 
 from ..obs import get_journal, tier_counters
+from ..utils.affinity import blocking, holds_lock, loop_only
 from .placement import PlacementDir
 
 #: subdirectory of the shard dir holding the routing table
@@ -171,6 +172,7 @@ class EpochTable:
             json.dump(rec, f)
         os.replace(tmp, self.path)
 
+    @holds_lock("epoch_table_flock")
     def record_claim(self, k: int, owner: str, addr: str,
                      cause: Optional[str] = None) -> int:
         """Record that ``owner@addr`` now serves partition ``k`` (initial
@@ -188,6 +190,7 @@ class EpochTable:
                           part=k, owner=owner, addr=addr, change="claim")
         return rec["epoch"]
 
+    @holds_lock("epoch_table_flock")
     def record_release(self, k: int, owner: str,
                        cause: Optional[str] = None) -> Optional[int]:
         """Drop ``k``'s route if ``owner`` still holds it; the bump makes
@@ -206,6 +209,7 @@ class EpochTable:
                           part=k, owner=owner, change="release")
         return rec["epoch"]
 
+    @holds_lock("epoch_table_flock")
     def record_core(self, owner: str, addr: str) -> None:
         """Register ``owner@addr`` as a member (ShardHost calls this once
         per poll — cheap no-op when the row already matches). Membership
@@ -225,6 +229,7 @@ class EpochTable:
                 "state": prev["state"] if prev else CORE_ACTIVE}
             self._write(rec)
 
+    @holds_lock("epoch_table_flock")
     def set_core_state(self, owner: str, state: str,
                        cause: Optional[str] = None) -> bool:
         """Flip a member's state (``admin placement drain``, or the
@@ -246,6 +251,7 @@ class EpochTable:
                              epoch=rec["epoch"], owner=owner, state=state)
         return True
 
+    @holds_lock("epoch_table_flock")
     def remove_core(self, owner: str, cause: Optional[str] = None) -> None:
         """Forget a decommissioned member entirely."""
         removed = False
@@ -369,6 +375,7 @@ class MigrationEngine:
 
     # -------------------------------------------------------------- source
 
+    @loop_only("core")
     def migrate(self, k: int, target_addr: str,
                 adopt: Optional[Callable[[int, str], dict]] = None,
                 on_flip: Optional[Callable] = None,
@@ -463,6 +470,7 @@ class MigrationEngine:
             host.servers[k] = host._make_server(k)
             host.hb_times[k] = time.monotonic()
 
+    @loop_only("core")
     def _rpc_adopt(self, k: int, target_addr: str) -> dict:
         """Default target-side handoff: one blocking admin RPC against the
         target core (uniform deployments share the admin secret)."""
@@ -481,6 +489,7 @@ class MigrationEngine:
 
     # -------------------------------------------------------------- target
 
+    @loop_only("core")
     def adopt(self, k: int, from_owner: str,
               cause: Optional[str] = None) -> dict:
         """Target side: take over ``k`` from ``from_owner`` and resume its
@@ -503,6 +512,9 @@ class MigrationEngine:
         return {"epoch": epoch, "journal": adopt_id}
 
 
+@blocking("synchronous socket dial + rid round trip — the loopback "
+          "migration/actuation seam (PR 10); never call on the loop "
+          "unless the synchrony IS the design")
 def admin_rpc(host: str, port: int, frame: dict,
               timeout: float = 30.0) -> dict:
     """One rid-matched admin RPC round trip (length-prefixed JSON — the

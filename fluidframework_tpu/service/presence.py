@@ -32,6 +32,7 @@ from ..protocol import binwire
 from ..protocol.messages import Signal
 from ..protocol.serialization import message_to_dict
 from ..utils.telemetry import Counters
+from ..utils.affinity import loop_only
 
 #: default flush tick — one frame per watcher per window, however many
 #: cursor moves arrived inside it
@@ -94,6 +95,7 @@ class PresenceLane:
 
     # --------------------------------------------------------- ingress
 
+    @loop_only("core")
     def publish(self, topic: str, signal: Signal) -> None:
         self.counters.inc("presence.lane.signals")
         bucket = self._store.setdefault(topic, {})
@@ -126,6 +128,7 @@ class PresenceLane:
 
     # ----------------------------------------------------------- flush
 
+    @loop_only("core")
     def flush(self) -> int:
         """Drain every dirty topic to its subscribers; returns the
         number of subscriber deliveries."""

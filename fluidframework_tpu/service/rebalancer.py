@@ -48,6 +48,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
 from ..obs import get_journal, get_registry
+from ..utils.affinity import blocking, ticker_thread
 from .placement_plane import (
     CORE_ACTIVE,
     CORE_DRAINED,
@@ -273,6 +274,7 @@ def collect_fleet_heat(table_rec: dict, self_owner: str,
     return heat, reachable
 
 
+@blocking("per-peer admin_rpc dial with a multi-second timeout — fleet fan-out must run on a ticker or an executor, never the loop")
 def peer_tier_snapshots(table_rec: dict, self_owner: str, tier: str,
                         secret: Optional[str] = None,
                         timeout: float = 5.0) -> list:
@@ -380,6 +382,7 @@ class Rebalancer:
             t.join(timeout=5.0)
             self._thread = None
 
+    @ticker_thread("rebalancer")
     def _run(self) -> None:
         while not self._stop.wait(self.tick_s):
             try:

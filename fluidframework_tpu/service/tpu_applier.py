@@ -65,6 +65,7 @@ from ..ops.doc_state import FLAG_MARKER, DocState, PropTable, TextArena, decode_
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from ..parallel.placement import DocPlacement
 from ..utils.contracts import register_kernel_contract
+from ..utils.affinity import blocking
 
 MARKER_GLYPH = "￼"  # arena placeholder byte for markers (flags classify)
 
@@ -848,6 +849,7 @@ class TpuDocumentApplier:
             self._registry = get_registry()
         return self._registry
 
+    @blocking("may block_until_ready the execution that last consumed the target buffer set — the PR 11 rotation fence")
     def _rotate_stage_buffers(self) -> None:
         """Flip to the other staging buffer set, fencing the EXECUTION
         that last consumed it (``jax.device_put`` may alias the host
@@ -875,6 +877,7 @@ class TpuDocumentApplier:
             buf.fill(0)
         return buf
 
+    @blocking("block_until_ready on the in-flight wave — the strict-wave-order fence at checkpoint/escalation seams")
     def _drain_device(self) -> None:
         """Fence the in-flight wave. Checkpoint/restore, escalation,
         force_wide, and state queries must never act on a farm with a

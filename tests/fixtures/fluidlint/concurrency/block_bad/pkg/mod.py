@@ -1,0 +1,31 @@
+"""Seeded BLOCKING-ON-LOOP: a coroutine body sleeps synchronously and
+dials a @blocking helper; a call_soon callback blocks too."""
+
+import time
+
+from .aff import blocking
+
+
+@blocking("socket dial + round trip")
+def dial(addr):
+    return addr
+
+
+async def poll_loop():
+    time.sleep(0.1)  # SEEDED VIOLATION: sync sleep in a coroutine
+
+
+async def fan_out():
+    return dial("peer:1")  # SEEDED VIOLATION: @blocking on the loop
+
+
+def sender(sock):
+    sock.sendall(b"x")  # blocker, but unseeded: no loop context here
+
+
+async def arm(loop):
+    loop.call_soon(flush_now)
+
+
+def flush_now(sock):
+    sock.sendall(b"y")  # SEEDED VIOLATION: call_soon callback blocks

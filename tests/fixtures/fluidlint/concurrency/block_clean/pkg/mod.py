@@ -1,0 +1,17 @@
+"""Clean twin: the blocking fan-out runs behind run_in_executor; the
+executor context is allowed to block."""
+
+from .aff import blocking
+
+
+@blocking("socket dial + round trip")
+def dial(addr):
+    return addr
+
+
+def fleet_work():
+    return [dial("peer:1"), dial("peer:2")]
+
+
+async def fan_out(loop):
+    return await loop.run_in_executor(None, fleet_work)

@@ -1,0 +1,14 @@
+"""Seeded CROSS-AFFINITY: the ticker calls a loop-affine mutator
+directly instead of going through a loopback seam."""
+
+from .aff import loop_only, ticker_thread
+
+
+@loop_only("core")
+def mutate_table(k):
+    return {"k": k}
+
+
+@ticker_thread("rebalancer")
+def tick():
+    return mutate_table(3)  # SEEDED VIOLATION: ticker -> @loop_only

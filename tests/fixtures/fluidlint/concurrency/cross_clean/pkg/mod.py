@@ -1,0 +1,14 @@
+"""Clean twin: the ticker crosses to the loop through the sanctioned
+call_soon_threadsafe seam, so no direct cross-affinity edge exists."""
+
+from .aff import loop_only, ticker_thread
+
+
+@loop_only("core")
+def mutate_table():
+    return {}
+
+
+@ticker_thread("rebalancer")
+def tick(loop):
+    loop.call_soon_threadsafe(mutate_table)

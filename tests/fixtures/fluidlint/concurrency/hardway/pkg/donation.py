@@ -1,0 +1,17 @@
+"""PR 11's donation-on-CPU bug, reconstructed: with the platform guard
+commented out, dispatch synchronizes on every wave — the serialization
+the overlap pipeline existed to avoid. The checker must see it."""
+
+from .aff import loop_only
+
+
+def apply_kernel(state, wave):
+    return state
+
+
+@loop_only("core")
+def dispatch(state, wave):
+    out = apply_kernel(state, wave)
+    # if platform != "cpu":  # the guard the bug was missing
+    out.block_until_ready()  # RECONSTRUCTED BUG: device sync on loop
+    return out

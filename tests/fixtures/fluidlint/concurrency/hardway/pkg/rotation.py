@@ -1,0 +1,23 @@
+"""PR 11's stage-buffer rotation bug, reconstructed: the refill fence
+keyed to input readiness instead of the CONSUMING execution — here
+reduced to its race shape: the staging slot is written by the loop's
+ingest and the worker's recycle with no fence at all."""
+
+import threading
+
+
+class Applier:
+    def __init__(self):
+        self._stage = None
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self.recycle,
+                                        name="applier")
+        self._worker.start()
+
+    async def ingest(self, ops):
+        self._stage = list(ops)  # RECONSTRUCTED BUG: no rotation fence
+
+    def recycle(self):
+        self._stage = None  # worker-side refill, same slot, no fence

@@ -1,0 +1,20 @@
+"""Seeded UNFENCED-SHARED-STATE: one attribute written from the worker
+thread and from a coroutine with no common lock."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.value = 0
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self.run, name="pump")
+        self._worker.start()
+
+    def run(self):
+        self.value = 1  # thread write, no fence
+
+    async def ingest(self, v):
+        self.value = v  # SEEDED VIOLATION: loop write, no common fence

@@ -1,0 +1,22 @@
+"""Clean twin: every cross-context write holds the same instance lock."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self.run, name="pump")
+        self._worker.start()
+
+    def run(self):
+        with self._lock:
+            self.value = 1
+
+    async def ingest(self, v):
+        with self._lock:
+            self.value = v

@@ -1,0 +1,6 @@
+"""Seeded frame-registry fixture: a reused wire id and a frame with no
+codec-manifest entry (tools/fluidlint/registries.py FT_CODECS)."""
+
+FT_SUBMIT = 1
+FT_OPS = 1  # SEEDED VIOLATION: id 1 reused
+FT_BOGUS = 9  # SEEDED VIOLATION: no (encoder, decoder) manifest entry

@@ -579,3 +579,22 @@ def test_cli_exits_nonzero_on_violation():
     r = _run_cli("--pass", "layers", "--repo-root", bad_root)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "'utils' may not import 'protocol'" in r.stdout
+
+
+# -------------------------------------------------- frame-id registry
+
+def test_frame_registry_real_tree_clean():
+    # every FT_* id unique, every id paired with both codec halves in
+    # registries.FT_CODECS, no stale manifest entries
+    assert wire_check.check_frame_registry(repo_root=REPO) == []
+
+
+def test_frame_registry_seeded_violations():
+    msgs = [v.message for v in wire_check.check_frame_registry(
+        repo_root=os.path.join(FIX, "wire_registry"))]
+    joined = "\n".join(msgs)
+    # a reused wire id is version skew baked into one binary
+    assert ("frame id 1 is assigned to both FT_SUBMIT and FT_OPS"
+            in joined)
+    # a frame id with no (encoder, decoder) manifest entry
+    assert "FT_BOGUS has no (encoder, decoder) entry" in joined

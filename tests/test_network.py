@@ -391,3 +391,117 @@ def test_vanished_server_detected_by_ping_escalation():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_close_wakes_requester_even_with_reader_parked():
+    """close() must wake a blocked requester DIRECTLY (under the
+    pending cv), not by relying on the reader thread's exit path: here
+    the reader is parked inside a push handler, so only the cv notify
+    in close() can deliver the wakeup. Found by fluidlint's
+    BLOCKING-ON-LOOP triage of request_rid (@blocking)."""
+    import json as _json
+    import socket as _socket
+    import threading as _threading
+
+    from fluidframework_tpu.driver.network import _Transport
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        # wait for the client's ready frame FIRST — pushing the stall
+        # before the handler registers would drop it silently
+        need = int.from_bytes(conn.recv(4), "big")
+        while need > 0:
+            need -= len(conn.recv(need))
+        # one rid-less push to park the reader in the stall handler;
+        # then silence — the requester below would wait out its full
+        # timeout without the close() fix
+        body = _json.dumps({"t": "stall"}).encode()
+        conn.sendall(len(body).to_bytes(4, "big") + body)
+        stop.wait(20.0)
+        conn.close()
+
+    stop = _threading.Event()
+    server_thread = _threading.Thread(target=serve, daemon=True)
+    server_thread.start()
+    t = _Transport("127.0.0.1", port, timeout=30.0)
+    parked = _threading.Event()
+    t.on_push("stall", lambda frame: (parked.set(), stop.wait(20.0)))
+    t.send({"t": "ready"})
+    assert parked.wait(10.0), "reader never entered the stall handler"
+
+    outcome = []
+
+    def request():
+        t0 = time.monotonic()
+        try:
+            t.request({"t": "admin_status"})
+        except ConnectionError as e:
+            outcome.append((time.monotonic() - t0, str(e)))
+
+    requester = _threading.Thread(target=request, daemon=True)
+    requester.start()
+    time.sleep(0.3)  # let it park on the cv
+    t.close()
+    requester.join(timeout=5.0)
+    stop.set()
+    srv.close()
+    assert outcome, "requester still blocked after close()"
+    elapsed, message = outcome[0]
+    assert elapsed < 5.0, f"woke by timeout, not by close(): {elapsed}"
+    assert "closed" in message
+
+
+def test_fleet_admin_fanout_does_not_stall_the_loop(tmp_path):
+    """The fleet placement fan-out (per-peer admin_rpc dials with
+    multi-second timeouts) must run OFF the event loop: while a slow
+    fan-out is in flight, a concurrent ping on the same connection
+    still turns around immediately, and the fleet reply arrives with
+    its counters intact. Found by fluidlint (BLOCKING-ON-LOOP via
+    peer_tier_snapshots); the fix is _ClientSession._reply_offloop."""
+    import threading as _threading
+
+    from fluidframework_tpu.driver.network import _Transport
+    from fluidframework_tpu.service.front_end import ShardHost
+
+    sh = ShardHost(str(tmp_path), 1, prefer=(0,))
+    fe = NetworkFrontEnd(shard_host=sh).start_background()
+    try:
+        slow = 1.5
+
+        def slow_counters(table_rec):
+            time.sleep(slow)  # a peer dial timing out, in miniature
+            return {"placement.fleet_probe": 7}
+
+        fe._fleet_placement_counters = slow_counters
+
+        t = _Transport("127.0.0.1", fe.port, timeout=10.0)
+        try:
+            fleet_reply = []
+
+            def fleet():
+                fleet_reply.append(
+                    t.request({"t": "admin_placement", "fleet": True}))
+
+            worker = _threading.Thread(target=fleet, daemon=True)
+            t0 = time.monotonic()
+            worker.start()
+            time.sleep(0.2)  # fan-out is now parked in the executor
+            t.request({"t": "admin_docs"})
+            ping_latency = time.monotonic() - t0
+            assert ping_latency < slow, \
+                f"loop stalled behind the fan-out: {ping_latency:.2f}s"
+            worker.join(timeout=10.0)
+            assert fleet_reply, "fleet reply never arrived"
+            placement = fleet_reply[0]["placement"]
+            assert placement["counters"] == {"placement.fleet_probe": 7}
+            # the synchronous fields rode along unharmed
+            assert placement["owner"] == sh.owner_id
+        finally:
+            t.close()
+    finally:
+        fe.stop()

@@ -21,6 +21,15 @@ fi
 echo "--- fluidlint (static contracts)"
 python -m tools.fluidlint
 
+# strict concurrency gate, run on its own so the CI log carries the
+# waiver ledger (every sanctioned crossing + its one-line argument)
+# as a first-class record: the commit fails on ANY unwaivered
+# cross-affinity call, loop-blocking reach, unfenced shared write, or
+# lock-order inversion — and on any stale waiver, so the exception
+# table cannot outlive the code it excuses
+echo "--- fluidlint concurrency pass (strict: zero unwaivered findings)"
+python -m tools.fluidlint --pass concurrency
+
 echo "--- pytest collection check"
 python -m pytest tests/ -q --collect-only -p no:cacheprovider >/dev/null
 echo "collection: ok"

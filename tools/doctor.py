@@ -10,7 +10,9 @@ table + membership), and per core under ``cores/<owner>/`` the metrics
 scrape (``scrape.prom``), windowed history rings (``history.json``),
 journal tail (``journal.jsonl``), SLO status (``slo.json``), rebalancer
 status (``rebalance.json``) and any flight dumps that were readable at
-capture (``flight/``). The doctor joins these into a triage report:
+capture (``flight/``); plus ``lint.json`` — the capturing build's
+``fluidlint --json`` report — whenever the repo checkout was present.
+The doctor joins these into a triage report:
 
 1. fleet summary — cores, states, capture errors;
 2. hop-pair latency table — the slowest legs of the pipeline by mean,
@@ -24,7 +26,9 @@ capture (``flight/``). The doctor joins these into a triage report:
    draining/drained cores still owning partitions, migration failures,
    rebalance suppression storms, version-skew hop drops
    (``obs.trace.unknown_hops``), disarmed journals, journal write
-   errors.
+   errors, and static-contract violations in the capturing build
+   (a dirty ``lint.json`` in production is an incident signal of its
+   own — someone deployed past the gate).
 
 Read-only; exit 0 with "healthy" when nothing needs attention, exit 1
 when any anomaly or active SLO burn was found (so a CI gate can assert
@@ -126,9 +130,19 @@ def diagnose(bundle_dir: str) -> dict:
     """Parse the bundle into a triage dict (the printable report's
     data source — tests and the net_smoke gate assert on this)."""
     report: dict = {"cores": {}, "hop_pairs": [], "slo_burn": [],
-                    "migrations": [], "anomalies": []}
+                    "migrations": [], "anomalies": [], "lint": None}
     anomalies = report["anomalies"]
     manifest = _load_json(os.path.join(bundle_dir, "manifest.json")) or {}
+    # static-contract status of the build that captured the bundle
+    # (admin bundle runs `fluidlint --json` when the repo is present):
+    # a dirty tree in production is itself an incident signal
+    lint = _load_json(os.path.join(bundle_dir, "lint.json"))
+    report["lint"] = lint
+    if lint is not None and not lint.get("clean", True):
+        for v in lint.get("violations", []):
+            anomalies.append(
+                f"lint [{v.get('pass')}]: {v.get('message')} "
+                f"({v.get('path')}:{v.get('line')})")
     placement = _load_json(os.path.join(bundle_dir, "placement.json"))
     cores_dir = os.path.join(bundle_dir, "cores")
     owners = (sorted(os.listdir(cores_dir))
@@ -259,6 +273,17 @@ def print_report(report: dict) -> None:
         print(f"  {_fmt_entry(m['entry'])}")
         for link in m["chain"]:
             print(f"    {_fmt_entry(link)}")
+    print("\n== static contracts (capturing build) ==")
+    lint = report.get("lint")
+    if lint is None:
+        print("  (no lint.json in bundle — captured without the repo)")
+    elif lint.get("clean"):
+        waived = lint.get("waived", [])
+        print(f"  clean ({len(lint.get('passes', []))} passes"
+              f", {len(waived)} waived concurrency finding(s))")
+    else:
+        print(f"  DIRTY: {len(lint.get('violations', []))} "
+              "violation(s) — see anomalies")
     print("\n== anomalies ==")
     if not report["anomalies"]:
         print("  none — healthy")

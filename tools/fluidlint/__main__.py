@@ -1,21 +1,29 @@
 """CLI: ``python -m tools.fluidlint [--pass NAME]... [--emit-packages-md]``.
 
 Exit codes: 0 clean, 1 violations found, 2 internal error.
+
+``--json`` emits a machine-readable report instead of text —
+``tools/doctor.py`` embeds it in debug bundles so a triage reads lint
+status next to the journal and metrics history. The concurrency pass
+also reports its applied waivers (each with its one-line
+justification), so the report always shows which contract crossings
+are sanctioned, not just that the tree is "clean".
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 PASSES = ("layers", "jaxpr", "wire", "hygiene", "metric-name", "storage",
-          "journal-kind")
+          "journal-kind", "concurrency")
 
 
-def run(passes, repo_root: str) -> list:
-    from . import (hygiene, jaxpr_check, journal_check, layers,
-                   metrics_check, storage_check, wire_check)
+def run(passes, repo_root: str, waived_out=None) -> list:
+    from . import (concurrency_check, hygiene, jaxpr_check, journal_check,
+                   layers, metrics_check, storage_check, wire_check)
 
     violations = []
     if "layers" in passes:
@@ -35,18 +43,40 @@ def run(passes, repo_root: str) -> list:
     if "journal-kind" in passes:
         violations += journal_check.check_journal_kinds(
             repo_root=repo_root)
+    if "concurrency" in passes:
+        violations += concurrency_check.check_concurrency(
+            repo_root=repo_root, waived_out=waived_out)
     return violations
+
+
+def print_lock_order() -> None:
+    """``tools/lint.sh --fix-order``: the canonical lock table."""
+    from .registries import LOCK_DOC, LOCK_ORDER
+
+    print("global lock acquisition order (outermost first):")
+    for i, name in enumerate(LOCK_ORDER):
+        print(f"  {i}. {name:<24} {LOCK_DOC.get(name, '')}")
+    print("\na function holding lock N may only acquire locks ranked "
+          "after N;\n@holds_lock names must appear here "
+          "(tools/fluidlint/registries.py).")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.fluidlint",
         description="static contract checker: layer DAG, TPU hot-path "
-                    "jaxpr contracts, wire-format widths")
+                    "jaxpr contracts, wire-format widths, concurrency "
+                    "contracts")
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=PASSES, metavar="|".join(PASSES),
                     help="run only the named pass (repeatable); "
                          "default: all")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a machine-readable JSON report (doctor "
+                         "embeds this in debug bundles)")
+    ap.add_argument("--fix-order", action="store_true",
+                    help="print the canonical lock acquisition order "
+                         "table and exit")
     ap.add_argument("--emit-packages-md", nargs="?", const="PACKAGES.md",
                     metavar="PATH",
                     help="regenerate the layer listing (like the "
@@ -56,6 +86,10 @@ def main(argv=None) -> int:
 
     repo_root = args.repo_root or os.path.abspath(
         os.path.join(os.path.dirname(__file__), "..", ".."))
+
+    if args.fix_order:
+        print_lock_order()
+        return 0
 
     if args.emit_packages_md is not None:
         from . import layers
@@ -72,11 +106,27 @@ def main(argv=None) -> int:
     # the jaxpr pass traces kernels; keep it off any real accelerator
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     passes = tuple(args.passes) if args.passes else PASSES
-    violations = run(passes, repo_root)
+    waived: list = []
+    violations = run(passes, repo_root, waived_out=waived)
+    n = len(violations)
+    if args.json:
+        print(json.dumps({
+            "clean": not n,
+            "passes": list(passes),
+            "violations": [
+                {"pass": v.pass_name, "path": v.path, "line": v.line,
+                 "message": v.message, "suggestion": v.suggestion}
+                for v in violations],
+            "waived": waived,
+        }, indent=2))
+        return 1 if n else 0
     for v in violations:
         print(v)
-    n = len(violations)
     names = ", ".join(passes)
+    if waived:
+        print(f"fluidlint: {len(waived)} waived concurrency finding(s):")
+        for w in waived:
+            print(f"  {w}")
     if n:
         print(f"\nfluidlint: {n} violation(s) [{names}]")
         return 1

@@ -30,37 +30,19 @@ import ast
 import os
 from typing import Iterable, Optional
 
+from .registries import JOURNAL_KINDS_HOME as KINDS_HOME
+from .registries import load_journal_kinds
 from .report import Violation
 
 #: Swept directories (repo-relative), same scope as the metric pass.
 JOURNAL_ROOTS = ("fluidframework_tpu",)
 
-#: The declaring module (repo-relative).
-KINDS_HOME = os.path.join("fluidframework_tpu", "obs", "journal.py")
-
 
 def load_kinds(repo_root: Optional[str] = None) -> Optional[frozenset]:
     """The declared kind set, or None when the KINDS table is missing
-    or not a pure literal (reported as a violation by the caller)."""
-    repo_root = repo_root or _repo_root()
-    path = os.path.join(repo_root, KINDS_HOME)
-    try:
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=path)
-    except (OSError, SyntaxError):
-        return None
-    for node in tree.body:
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == "KINDS"):
-            try:
-                kinds = ast.literal_eval(node.value)
-            except ValueError:
-                return None
-            if isinstance(kinds, dict):
-                return frozenset(kinds)
-            return None
-    return None
+    or not a pure literal (reported as a violation by the caller).
+    Delegates to the registry manifest (tools/fluidlint/registries.py)."""
+    return load_journal_kinds(repo_root or _repo_root())
 
 
 def _literal_kinds(node: ast.expr) -> Iterable[str]:

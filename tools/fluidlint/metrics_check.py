@@ -14,7 +14,8 @@ Two checks over the library package:
 - **locked families**: the ``obs.slo.*`` and ``net.admission.*``
   namespaces are alert-surface contracts — dashboards and the overload
   bench key on the exact member set. A new name under a locked prefix
-  must be added to :data:`LOCKED_FAMILIES` here in the same change, or
+  must be added to ``LOCKED_FAMILIES`` in
+  ``tools/fluidlint/registries.py`` in the same change, or
   the lint refuses it (spelling drift like ``net.admission.dropped`` vs
   the canonical ``net.admission.shed`` is exactly the bug this catches).
 - **Counters construction**: ``Counters(...)`` may only be constructed
@@ -32,6 +33,7 @@ import os
 import re
 from typing import Iterable, Optional
 
+from .registries import LOCKED_FAMILIES  # noqa: F401 — re-exported
 from .report import Violation
 
 #: Swept directories (repo-relative). Tests and tools construct Counters
@@ -48,88 +50,6 @@ COUNTERS_HOMES = (
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+){2,3}$")
 
 _METHODS = ("inc", "observe", "set_gauge", "observe_windowed")
-
-#: prefix -> exact member set. These families are overload-control
-#: alert surfaces (SLO dashboards, the overload bench's gates, the
-#: noisy-neighbor scenario); a name under one of these prefixes that
-#: is not in the set is either a typo or an unreviewed contract change.
-LOCKED_FAMILIES = {
-    "obs.slo.": frozenset({"obs.slo.state", "obs.slo.violations"}),
-    "net.admission.": frozenset({"net.admission.shed",
-                                 "net.admission.delayed"}),
-    # the snapshot fast-boot plane: the net-smoke catch-up gate, the
-    # join-storm bench, and the chaos soak all key on these exact names
-    "boot.": frozenset({"boot.snapshot.used", "boot.snapshot.fallback",
-                        "boot.snapshot.reanchor", "boot.backfill.bounded",
-                        "boot.backfill.full", "boot.chunks.fetched",
-                        "boot.chunks.cached"}),
-    "storage.snapshot.": frozenset({"storage.snapshot.encodes",
-                                    "storage.snapshot.cache_hits",
-                                    "storage.snapshot.served",
-                                    "storage.snapshot.legacy_tree",
-                                    "storage.snapshot.chunks_written",
-                                    "storage.snapshot.chunks_reused"}),
-    # the placement control plane: the net-smoke migration gate, the
-    # admin CLI, and the chaos migration campaign key on these exact
-    # names (service/placement_plane.py)
-    # the device-dispatch pipeline: MULTICHIP's smoke gate counter-
-    # asserts overlap_ratio, profile_applier prints the stage/execute
-    # split, and the r7+ plateau analysis keys on these exact names
-    # (service/tpu_applier.py)
-    "applier.": frozenset({"applier.kernel.recompiled",
-                           "applier.stage.seconds",
-                           "applier.stage.bytes",
-                           "applier.stage.overlap_ratio",
-                           "applier.exec.seconds"}),
-    # placement.heat.* are the rebalancer's windowed per-partition load
-    # series (labeled part=<k>); placement.rebalance.* count the
-    # self-driving loop's decisions — the storm bench's flap-free gate
-    # and the elastic-sweep audit key on these exact names
-    # (service/rebalancer.py)
-    "placement.": frozenset({"placement.epoch.bumps",
-                             "placement.epoch.stale_nacks",
-                             "placement.cache.hits",
-                             "placement.cache.refreshes",
-                             "placement.cache.invalidations",
-                             "placement.submits.redirected",
-                             "placement.migration.fences",
-                             "placement.migration.committed",
-                             "placement.migration.failed",
-                             "placement.migration.adopted",
-                             "placement.heat.ops",
-                             "placement.heat.bytes",
-                             "placement.rebalance.ticks",
-                             "placement.rebalance.plans",
-                             "placement.rebalance.migrations_issued",
-                             "placement.rebalance.suppressed_hysteresis",
-                             "placement.rebalance.suppressed_budget"}),
-    # the read-scale fan-out tier (ISSUE 12): the net-smoke relay gate
-    # counter-asserts splices > 0 and encodes == 0 above the first
-    # gateway level, and the read-storm bench keys on upstream bytes —
-    # these exact names are the relay tree's perf contract
-    # (service/gateway.py). NOTE: "fanout." does not collide with the
-    # front end's "net.fanout.*" cache counters — prefixes match from
-    # the name's start.
-    "fanout.": frozenset({"fanout.relay.splices",
-                          "fanout.relay.encodes",
-                          "fanout.upstream.frames",
-                          "fanout.upstream.bytes"}),
-    # the ephemeral presence lane: the soak's drop/dup rules prove loss
-    # is invisible BECAUSE coalescing happens, which only these names
-    # witness (service/presence.py)
-    "presence.": frozenset({"presence.lane.signals",
-                            "presence.lane.coalesced",
-                            "presence.lane.flushes",
-                            "presence.lane.delivered"}),
-    "session.readonly.": frozenset({"session.readonly.connects"}),
-    # the control-plane audit journal's own health counters: the bench
-    # journal A/B and the doctor's write-error triage key on these
-    # exact names (obs/journal.py)
-    "obs.journal.": frozenset({"obs.journal.entries",
-                               "obs.journal.bytes",
-                               "obs.journal.errors",
-                               "obs.journal.rotations"}),
-}
 
 
 def _py_files(root: str) -> Iterable[str]:
@@ -184,7 +104,7 @@ def check_file(path: str, repo_root: Optional[str] = None
                                         f"{', '.join(sorted(members))})",
                                 suggestion="add it to LOCKED_FAMILIES in "
                                            "tools/fluidlint/"
-                                           "metrics_check.py if the "
+                                           "registries.py if the "
                                            "contract change is "
                                            "intentional"))
         if (isinstance(func, ast.Name) and func.id == "Counters"

@@ -1,0 +1,249 @@
+"""The frozen registries, in one manifest.
+
+Each fluidlint pass used to hand-roll its own loader for the contract
+table it checks against — the journal pass parsed ``KINDS`` out of
+``obs/journal.py``, the metric pass carried ``LOCKED_FAMILIES`` inline,
+the wire pass knew the codec files but not the frame-id inventory, and
+the hop taxonomy lived only in ``utils/telemetry.py``. This module is
+the single home: every registry that is a WIRE or ALERT contract (ids
+and names other builds/dashboards key on) loads or lives here, so a
+pass that needs one imports it instead of re-parsing, and a human
+auditing "what is frozen in this tree" reads one file.
+
+Registries:
+
+- :func:`load_journal_kinds` — the audit journal's closed kind
+  vocabulary (``obs/journal.py`` ``KINDS``; must stay a pure literal).
+- :func:`load_hops` — the hop taxonomy (``utils/telemetry.py``
+  ``HOPS``): ids 0–8 are FROZEN wire values stamped into trace tails.
+- :data:`LOCKED_FAMILIES` — metric families whose exact member sets
+  are alert-surface contracts (moved here from metrics_check).
+- :func:`load_frame_types` — the binary codec's ``FT_*`` frame ids
+  (``protocol/binwire.py``); ids are frozen wire values.
+- :data:`FT_CODECS` — frame type → (encoder, decoder) pairing: every
+  frame id on the wire must have both halves, checked by wire_check.
+- :data:`LOCK_ORDER` / :data:`LOCK_DOC` — the single global lock
+  acquisition order the concurrency pass enforces (outermost first).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+#: Declaring modules (repo-relative).
+JOURNAL_KINDS_HOME = os.path.join("fluidframework_tpu", "obs",
+                                  "journal.py")
+HOPS_HOME = os.path.join("fluidframework_tpu", "utils", "telemetry.py")
+BINWIRE_HOME = os.path.join("fluidframework_tpu", "protocol",
+                            "binwire.py")
+
+
+def _repo_root() -> str:
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _module_literal(path: str, name: str):
+    """The value of a module-level ``name = <pure literal>`` assignment,
+    or None when missing / not a literal."""
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            try:
+                return ast.literal_eval(node.value)
+            except ValueError:
+                return None
+    return None
+
+
+# ---------------------------------------------------------- journal kinds
+
+def load_journal_kinds(repo_root: Optional[str] = None
+                       ) -> Optional[frozenset]:
+    """The declared journal kind set, or None when the KINDS table is
+    missing or not a pure literal (the journal pass reports that)."""
+    repo_root = repo_root or _repo_root()
+    kinds = _module_literal(
+        os.path.join(repo_root, JOURNAL_KINDS_HOME), "KINDS")
+    if isinstance(kinds, dict):
+        return frozenset(kinds)
+    return None
+
+
+# ------------------------------------------------------------ hop taxonomy
+
+def load_hops(repo_root: Optional[str] = None) -> Optional[tuple]:
+    """The hop taxonomy as ((service, action, short), ...) — index IS
+    the frozen wire id. None when HOPS is missing or not a literal."""
+    repo_root = repo_root or _repo_root()
+    hops = _module_literal(os.path.join(repo_root, HOPS_HOME), "HOPS")
+    if (isinstance(hops, tuple)
+            and all(isinstance(h, tuple) and len(h) == 3 for h in hops)):
+        return hops
+    return None
+
+
+# -------------------------------------------------------- metric families
+
+#: prefix -> exact member set. These families are overload-control
+#: alert surfaces (SLO dashboards, the overload bench's gates, the
+#: noisy-neighbor scenario); a name under one of these prefixes that
+#: is not in the set is either a typo or an unreviewed contract change.
+LOCKED_FAMILIES = {
+    "obs.slo.": frozenset({"obs.slo.state", "obs.slo.violations"}),
+    "net.admission.": frozenset({"net.admission.shed",
+                                 "net.admission.delayed"}),
+    # the snapshot fast-boot plane: the net-smoke catch-up gate, the
+    # join-storm bench, and the chaos soak all key on these exact names
+    "boot.": frozenset({"boot.snapshot.used", "boot.snapshot.fallback",
+                        "boot.snapshot.reanchor", "boot.backfill.bounded",
+                        "boot.backfill.full", "boot.chunks.fetched",
+                        "boot.chunks.cached"}),
+    "storage.snapshot.": frozenset({"storage.snapshot.encodes",
+                                    "storage.snapshot.cache_hits",
+                                    "storage.snapshot.served",
+                                    "storage.snapshot.legacy_tree",
+                                    "storage.snapshot.chunks_written",
+                                    "storage.snapshot.chunks_reused"}),
+    # the device-dispatch pipeline: MULTICHIP's smoke gate counter-
+    # asserts overlap_ratio, profile_applier prints the stage/execute
+    # split, and the r7+ plateau analysis keys on these exact names
+    # (service/tpu_applier.py)
+    "applier.": frozenset({"applier.kernel.recompiled",
+                           "applier.stage.seconds",
+                           "applier.stage.bytes",
+                           "applier.stage.overlap_ratio",
+                           "applier.exec.seconds"}),
+    # the placement control plane: the net-smoke migration gate, the
+    # admin CLI, and the chaos migration campaign key on these exact
+    # names (service/placement_plane.py); placement.heat.* are the
+    # rebalancer's windowed per-partition load series and
+    # placement.rebalance.* count the self-driving loop's decisions —
+    # the storm bench's flap-free gate keys on them
+    "placement.": frozenset({"placement.epoch.bumps",
+                             "placement.epoch.stale_nacks",
+                             "placement.cache.hits",
+                             "placement.cache.refreshes",
+                             "placement.cache.invalidations",
+                             "placement.submits.redirected",
+                             "placement.migration.fences",
+                             "placement.migration.committed",
+                             "placement.migration.failed",
+                             "placement.migration.adopted",
+                             "placement.heat.ops",
+                             "placement.heat.bytes",
+                             "placement.rebalance.ticks",
+                             "placement.rebalance.plans",
+                             "placement.rebalance.migrations_issued",
+                             "placement.rebalance.suppressed_hysteresis",
+                             "placement.rebalance.suppressed_budget"}),
+    # the read-scale fan-out tier (ISSUE 12): the net-smoke relay gate
+    # counter-asserts splices > 0 and encodes == 0 above the first
+    # gateway level, and the read-storm bench keys on upstream bytes —
+    # these exact names are the relay tree's perf contract
+    # (service/gateway.py). NOTE: "fanout." does not collide with the
+    # front end's "net.fanout.*" cache counters — prefixes match from
+    # the name's start.
+    "fanout.": frozenset({"fanout.relay.splices",
+                          "fanout.relay.encodes",
+                          "fanout.upstream.frames",
+                          "fanout.upstream.bytes"}),
+    # the ephemeral presence lane: the soak's drop/dup rules prove loss
+    # is invisible BECAUSE coalescing happens, which only these names
+    # witness (service/presence.py)
+    "presence.": frozenset({"presence.lane.signals",
+                            "presence.lane.coalesced",
+                            "presence.lane.flushes",
+                            "presence.lane.delivered"}),
+    "session.readonly.": frozenset({"session.readonly.connects"}),
+    # the control-plane audit journal's own health counters: the bench
+    # journal A/B and the doctor's write-error triage key on these
+    # exact names (obs/journal.py)
+    "obs.journal.": frozenset({"obs.journal.entries",
+                               "obs.journal.bytes",
+                               "obs.journal.errors",
+                               "obs.journal.rotations"}),
+}
+
+
+# ----------------------------------------------------------- frame types
+
+def load_frame_types(repo_root: Optional[str] = None) -> dict:
+    """Module-level ``FT_* = <int>`` assignments from the binary codec:
+    {name: (id, lineno)}. Ids are frozen wire values."""
+    repo_root = repo_root or _repo_root()
+    path = os.path.join(repo_root, BINWIRE_HOME)
+    out: dict = {}
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return out
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.startswith("FT_")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+#: frame type -> (encoder fn, decoder fn) in protocol/binwire.py. Both
+#: halves must exist for every id on the wire: a frame a peer can send
+#: that this build cannot read (or the reverse) is version skew baked
+#: into one binary. wire_check asserts the manifest covers every FT_*
+#: assignment and that both named functions are defined.
+FT_CODECS = {
+    "FT_SUBMIT": ("encode_submit", "decode_submit"),
+    "FT_OPS": ("encode_ops", "decode_ops"),
+    "FT_FSUBMIT": ("encode_submit", "decode_submit"),
+    "FT_FOPS": ("encode_ops", "decode_ops"),
+    "FT_COLS_SUBMIT": ("encode_submit_columns", "decode_submit_columns"),
+    "FT_COLS_FSUBMIT": ("encode_submit_columns",
+                        "decode_submit_columns"),
+    "FT_COLS_OPS": ("stamp_cols_ops", "decode_cols_ops"),
+    "FT_COLS_FOPS": ("stamp_cols_ops", "decode_cols_ops"),
+    "FT_COLS_DELTAS": ("cols_deltas_body", "read_cols_deltas"),
+    "FT_COLS_SNAP": ("snap_chunk_body", "read_snap_chunk"),
+    "FT_PRESENCE": ("encode_presence", "decode_presence"),
+    "FT_FPRESENCE": ("encode_presence", "decode_presence"),
+}
+
+
+# ------------------------------------------------------------- lock order
+
+#: THE global lock acquisition order, outermost first. A function that
+#: acquires a later lock may not then acquire an earlier one — the
+#: concurrency pass enforces this over `with` nesting and @holds_lock
+#: annotations, so an epoch-table↔lease deadlock cannot land silently
+#: as multi-host fleet ops add acquirers. `tools/lint.sh --fix-order`
+#: prints this table.
+LOCK_ORDER = (
+    "epoch_table_flock",      # placement_plane._flock(table.lock)
+    "partition_claim_flock",  # placement.PlacementDir._lock(k)
+    "applier_lock",           # tpu_applier.TpuDocumentApplier._lock
+    "journal_lock",           # obs.journal.Journal._lock
+)
+
+#: lock name -> what it guards (printed by --fix-order and the report).
+LOCK_DOC = {
+    "epoch_table_flock": "the fleet epoch table file "
+                         "(service/placement_plane.py _flock)",
+    "partition_claim_flock": "per-partition lease files "
+                             "(service/placement.py PlacementDir._lock)",
+    "applier_lock": "the applier's staging double-buffer "
+                    "(service/tpu_applier.py, worker vs ingest)",
+    "journal_lock": "the audit journal's append stream "
+                    "(obs/journal.py Journal._lock)",
+}
+
+LOCK_RANK = {name: i for i, name in enumerate(LOCK_ORDER)}

@@ -29,6 +29,7 @@ import ast
 import os
 from typing import Optional
 
+from .registries import BINWIRE_HOME, FT_CODECS, load_frame_types
 from .report import Violation
 
 #: Files the wire pass covers on the real tree (repo-relative).
@@ -217,11 +218,80 @@ def check_struct_widths(path: str,
     return out
 
 
+def check_frame_registry(repo_root: Optional[str] = None
+                         ) -> list[Violation]:
+    """FT_* frame ids unique, and every id paired with both codec
+    halves (registries.FT_CODECS). A frame a peer can send that this
+    build cannot decode — or an id silently reused — is version skew
+    baked into one binary."""
+    repo_root = repo_root or _repo_root()
+    frames = load_frame_types(repo_root)
+    out: list[Violation] = []
+    if not frames:
+        return [Violation(
+            pass_name="wire", path=BINWIRE_HOME, line=1,
+            message="no FT_* frame-id assignments found — the frame "
+                    "registry check cannot read the codec",
+            suggestion="keep FT_* module-level int literals in "
+                       "protocol/binwire.py")]
+    by_id: dict[int, str] = {}
+    for name, (fid, lineno) in sorted(frames.items(),
+                                      key=lambda kv: kv[1][0]):
+        if fid in by_id:
+            out.append(Violation(
+                pass_name="wire", path=BINWIRE_HOME, line=lineno,
+                message=f"frame id {fid} is assigned to both "
+                        f"{by_id[fid]} and {name} — wire ids must be "
+                        "unique",
+                suggestion="pick the next unused id; existing ids are "
+                           "frozen wire values"))
+        by_id.setdefault(fid, name)
+        if name not in FT_CODECS:
+            out.append(Violation(
+                pass_name="wire", path=BINWIRE_HOME, line=lineno,
+                message=f"{name} has no (encoder, decoder) entry in "
+                        "the codec manifest",
+                suggestion="declare the pair in FT_CODECS in "
+                           "tools/fluidlint/registries.py in the same "
+                           "change"))
+    # both halves of every declared pair must exist as module functions
+    path = os.path.join(repo_root, BINWIRE_HOME)
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        defined = {n.name for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+    except (OSError, SyntaxError):
+        defined = set()
+    for name, (enc, dec) in sorted(FT_CODECS.items()):
+        if name not in frames:
+            out.append(Violation(
+                pass_name="wire", path=BINWIRE_HOME, line=1,
+                message=f"FT_CODECS declares {name} but the codec "
+                        "defines no such frame id",
+                suggestion="remove the stale manifest entry or restore "
+                           "the frame id"))
+            continue
+        lineno = frames[name][1]
+        for role, fn in (("encoder", enc), ("decoder", dec)):
+            if fn not in defined:
+                out.append(Violation(
+                    pass_name="wire", path=BINWIRE_HOME, line=lineno,
+                    message=f"{name} names {role} {fn}() which is not "
+                            "defined in the codec — every frame id "
+                            "needs both halves",
+                    suggestion="define it, or fix the FT_CODECS pair"))
+    return out
+
+
 def check_wire(paths: Optional[tuple] = None,
                repo_root: Optional[str] = None) -> list[Violation]:
     repo_root = repo_root or _repo_root()
-    paths = paths or tuple(os.path.join(repo_root, p) for p in WIRE_FILES)
     out: list[Violation] = []
+    if paths is None:
+        paths = tuple(os.path.join(repo_root, p) for p in WIRE_FILES)
+        out.extend(check_frame_registry(repo_root))
     for p in paths:
         out.extend(check_struct_widths(p, repo_root))
         out.extend(check_int16_discipline(p, repo_root))

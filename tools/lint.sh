@@ -2,12 +2,17 @@
 # Repo lint gate: the static contract checker + a pytest collection
 # smoke test (import errors surface here, not mid-CI).
 #
-#   tools/lint.sh            # all fluidlint passes + collection check
-#   tools/lint.sh layers     # just one fluidlint pass
+#   tools/lint.sh              # all fluidlint passes + collection check
+#   tools/lint.sh layers       # just one fluidlint pass
+#   tools/lint.sh --fix-order  # print the canonical lock order table
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if [ "${1:-}" = "--fix-order" ]; then
+    exec python -m tools.fluidlint --fix-order
+fi
 
 if [ "$#" -gt 0 ]; then
     args=()
