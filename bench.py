@@ -1687,6 +1687,247 @@ def bench_net_rebalance_storm() -> dict:
     }
 
 
+def bench_net_fork_storm() -> dict:
+    """Near-free fork at scale: 1k forks of a ≥100k-op doc.
+
+    ONE in-process front end over a durable log + on-disk chunk store
+    (the server must be reachable for byte accounting — the storm's
+    storage cost is measured as real directory growth, not a counter's
+    claim). The doc is driven to ≥100k sequenced ops at the config-4
+    per-doc geometry, summarized ONCE, then forked 1000 times through
+    the socket history door. Published and asserted:
+
+    - **p50/p99 fork-boot ms**: wall time of each ``history fork`` RPC —
+      the server seeds the fork's v0 (parent chunks re-referenced),
+      adopts the post-base tail, and constructs the fork's pipeline
+      before replying, so the RPC IS the boot;
+    - **bytes-per-fork + dedupe ratio**: on-disk growth across the storm
+      divided by forks, against the snapshot bytes each fork
+      re-references — the near-zero-copy claim, asserted ≥ 10x;
+    - **O(snapshot) client boots** (hard): a sample of forks cold-boots
+      through fresh Loaders; ``boot.backfill.full`` must stay ZERO for
+      the whole storm window (a fork that silently replays the parent's
+      100k ops fails here, not in a latency mystery);
+    - **integrate equivalence** (hard, seeds 0/7/42): fork + concurrent
+      parent/fork writers + integrate, then the parent replayed TWO
+      independent ways — history-first over sockets vs whole-log from a
+      recorded file doc — must agree on every shared fingerprint seq
+      and the final text.
+    """
+    import os
+    import random
+    import shutil
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from fluidframework_tpu.driver.file import (
+        FileDocumentService,
+        record_document,
+    )
+    from fluidframework_tpu.driver.network import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.obs import tier_counters, tier_snapshot
+    from fluidframework_tpu.replay.tool import ReplayController
+    from fluidframework_tpu.service.durable_log import DurableLog
+    from fluidframework_tpu.service.front_end import NetworkFrontEnd
+    from fluidframework_tpu.service.local_server import LocalServer
+    from fluidframework_tpu.service.service_summarizer import (
+        HostReplicaSource,
+        ServiceSummarizer,
+    )
+
+    doc = "fstorm0"
+    n_forks = 1000
+    boot_sample = 16
+
+    def du(path):
+        total = 0
+        for dirpath, _, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    pass
+        return total
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3) if vals else None
+
+    root = tempfile.mkdtemp(prefix="bench-fork-")
+    server = LocalServer(log=DurableLog(os.path.join(root, "log")),
+                         storage_dir=os.path.join(root, "store"))
+    front = NetworkFrontEnd(server).start_background()
+    port = front.port
+    factory = NetworkDocumentServiceFactory("127.0.0.1", port)
+    drv = tier_counters("driver")
+
+    def quiesce(container, what):
+        deadline = _time.time() + 60
+        while container.runtime.pending.count and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert container.runtime.pending.count == 0, \
+            f"{what} never quiesced"
+
+    try:
+        # attach + drive the long-lived doc (10 clients × 320 × 32-op
+        # boxcars = 102,400 ops — the join-storm geometry)
+        writer = Loader(factory).resolve("bench", doc)
+        ss = writer.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        ss.insert_text(0, "fork-storm seed ")
+        quiesce(writer, "fork-storm writer")
+        w = subprocess.Popen(
+            _lean_cmd("fluidframework_tpu.service.load_async",
+                      "--port", str(port), "--docs", "1",
+                      "--clients-per-doc", "10", "--rounds", "320",
+                      "--batch", "32", "--rate", "8", "--seed", "7",
+                      "--doc-prefix", "fstorm", "--timeout", "300"),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=REPO, env=_lean_env())
+        doc_ops = json.loads(w.communicate(timeout=900)[0])["acked"]
+        assert doc_ops >= 100_000, f"doc too short: {doc_ops} acked"
+        writer.close()
+
+        ServiceSummarizer(server, HostReplicaSource(server)).summarize_doc(
+            "bench", doc)
+        head = server.history.log("bench", doc)[0]
+        shared_bytes = sum(len(server.blob_store.get(cid))
+                           for cid in head["chunk_ids"])
+
+        # the storm: 1k fork RPCs through the socket history door
+        h = factory.create_document_service("bench", doc).history()
+        pre_bytes = du(root)
+        pre_svc = tier_snapshot("service")
+        pre_drv = drv.snapshot()
+        fork_ms = []
+        for i in range(n_forks):
+            t0 = _time.perf_counter()
+            res = h.fork(new_doc=f"fstormf{i:04d}")
+            fork_ms.append(round((_time.perf_counter() - t0) * 1e3, 2))
+            assert res["shared_chunks"] > 0, f"fork {i} shared no chunks"
+        post_bytes = du(root)
+        post_svc = tier_snapshot("service")
+
+        def delta(post, pre, name):
+            return post.get(name, 0) - pre.get(name, 0)
+
+        boots = delta(post_svc, pre_svc, "history.fork.boots")
+        assert boots == n_forks, \
+            f"server counted {boots} fork boots for {n_forks} forks"
+        bytes_per_fork = round((post_bytes - pre_bytes) / n_forks, 1)
+        dedupe_x = round(shared_bytes / max(bytes_per_fork, 1.0), 1)
+        assert dedupe_x >= 10.0, \
+            (f"forks are not near-free: {bytes_per_fork} B/fork written "
+             f"vs {shared_bytes} B re-referenced ({dedupe_x}x)")
+
+        # O(snapshot) sample boots: cold Loaders on a spread of forks
+        want_text = None
+        tti = []
+        for i in range(0, n_forks, max(1, n_forks // boot_sample)):
+            jf = NetworkDocumentServiceFactory("127.0.0.1", port,
+                                               counters=drv)
+            t0 = _time.perf_counter()
+            c = Loader(jf).resolve("bench", f"fstormf{i:04d}")
+            text = (c.runtime.get_data_store("default")
+                    .get_channel("text").get_text())
+            tti.append(round(_time.perf_counter() - t0, 3))
+            if want_text is None:
+                want_text = text
+            assert text == want_text, f"fork {i} diverged from the storm"
+            c.close()
+        post_drv = drv.snapshot()
+        full = delta(post_drv, pre_drv, "boot.backfill.full")
+        assert full == 0, \
+            f"{full} whole-log replay(s) inside the fork storm window"
+        assert delta(post_drv, pre_drv, "boot.backfill.bounded") \
+            == len(tti), "a fork boot was not snapshot-bounded"
+
+        # integrate equivalence at three seeds: concurrent fork/parent
+        # writers, integrate, then two INDEPENDENT replays of the parent
+        # (history-first over sockets vs whole-log from a file record)
+        # must agree on every shared fingerprint
+        eq_fps = 0
+        for seed in (0, 7, 42):
+            dn, fn = f"eq{seed}", f"eq{seed}f"
+            rng = random.Random(seed)
+            pw = Loader(factory).resolve("bench", dn)
+            ps = pw.runtime.create_data_store("default").create_channel(
+                "text", "shared-string")
+            for i in range(24):
+                ps.insert_text(rng.randrange(len(ps.get_text()) + 1),
+                               f"s{i} ")
+            quiesce(pw, f"eq{seed} base writer")
+            ServiceSummarizer(
+                server, HostReplicaSource(server)).summarize_doc(
+                "bench", dn)
+            factory.create_document_service("bench", dn).history().fork(
+                new_doc=fn)
+            fw = Loader(factory).resolve("bench", fn)
+            fs = fw.runtime.get_data_store("default").get_channel("text")
+            for i in range(6):  # interleaved divergence on both sides
+                fs.insert_text(rng.randrange(len(fs.get_text()) + 1),
+                               f"F{i} ")
+                ps.insert_text(rng.randrange(len(ps.get_text()) + 1),
+                               f"P{i} ")
+            quiesce(fw, f"eq{seed} fork writer")
+            quiesce(pw, f"eq{seed} parent writer")
+            out = factory.create_document_service(
+                "bench", fn).history().integrate()
+            assert out["ops"] == 6, f"seed {seed}: {out['ops']} ops"
+            deadline = _time.time() + 60
+            while ps.get_text().count("F") < 6 \
+                    and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert ps.get_text().count("F") == 6, \
+                f"seed {seed}: integrated edits never landed"
+            hist = ReplayController(factory.create_document_service(
+                "bench", dn)).run(25)
+            with tempfile.TemporaryDirectory() as d:
+                doc_dir = record_document(server, "bench", dn, d)
+                snap = os.path.join(doc_dir, "snapshot.json")
+                if os.path.exists(snap):
+                    os.remove(snap)
+                legacy = ReplayController(
+                    FileDocumentService.from_dir(doc_dir)).run(25)
+            assert hist["final_text"] == legacy["final_text"] \
+                == ps.get_text(), f"seed {seed}: final-text drift"
+            common = set(hist["snapshots"]) & set(legacy["snapshots"])
+            assert common, f"seed {seed}: no shared fingerprint seqs"
+            for q in common:
+                assert hist["snapshots"][q] == legacy["snapshots"][q], \
+                    f"seed {seed}: fingerprint drift at seq {q}"
+            eq_fps += len(common)
+            fw.close()
+            pw.close()
+
+        return {
+            "doc_ops": doc_ops,
+            "forks": n_forks,
+            "fork_p50_ms": pct(fork_ms, 0.5),
+            "fork_p99_ms": pct(fork_ms, 0.99),
+            "bytes_per_fork": bytes_per_fork,
+            "snapshot_bytes_shared": shared_bytes,
+            "dedupe_ratio_x": dedupe_x,
+            "boot_sample_tti_p50_s": pct(tti, 0.5),
+            "boot_sample_boots": len(tti),
+            "boot_backfill_full_in_bench": full,
+            "integrate_equivalence": {"seeds": [0, 7, 42], "ok": True,
+                                      "fingerprints_compared": eq_fps},
+            "counters": {
+                "history.fork.boots": boots,
+                "history.fork.tail_ops": delta(
+                    post_svc, pre_svc, "history.fork.tail_ops"),
+                "history.commit.records": post_svc.get(
+                    "history.commit.records", 0),
+            },
+        }
+    finally:
+        front.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_multichip() -> dict:
     """Per-device scaling of the doc-mesh lane (tools/bench_multichip):
     docs axis 1→2→4→8 on forced host devices, in a FRESH process — XLA
@@ -1726,6 +1967,7 @@ def main() -> None:
     join_storm = bench_join_storm()
     read_storm = bench_net_read_storm()
     rebalance_storm = bench_net_rebalance_storm()
+    fork_storm = bench_net_fork_storm()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -1854,6 +2096,14 @@ def main() -> None:
                 # migrate (fleet counters), never flap, lose nothing,
                 # and end with every core owning partitions
                 "net_rebalance_storm": rebalance_storm,
+                # doc history plane at scale: 1k near-free forks of a
+                # ≥100k-op doc through the socket history door — fork
+                # RPC p50/p99, on-disk bytes-per-fork vs the snapshot
+                # bytes each fork re-references (dedupe ≥10x asserted),
+                # zero whole-log replays in-bench, and the integrated
+                # parent fingerprint-equal across history-first and
+                # whole-log replays at seeds 0/7/42
+                "net_fork_storm": fork_storm,
                 # per-device scaling of the doc-mesh applier lane (docs
                 # axis 1→2→4→8, forced host devices; full artifact in
                 # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh

@@ -424,6 +424,58 @@ def _bundle(args) -> int:
     return 0
 
 
+def _history_cmd(args) -> int:
+    """Doc history plane views/actions over the ``history_*`` doors.
+    These ride the tenant token (doc scopes), not the admin secret —
+    forking or time-traveling a doc is a data-plane act."""
+    from .driver.network import _Transport
+
+    t = _Transport(args.host, args.port, timeout=30.0)
+    try:
+        base = {"tenant": args.tenant, "doc": args.doc,
+                "token": args.token}
+        if args.action == "log":
+            rid, reply = t.request_rid(dict(
+                base, t="history_log", count=args.n or None))
+            commits = t.take_history(rid)
+            heads = {cid: name for name, cid in
+                     (reply.get("refs") or {}).items()}
+            for c in commits:
+                head = heads.get(c["id"])
+                fork_of = (c.get("extra") or {}).get("fork_of")
+                line = (f"{c['id']} {c['version']} @seq {c['base_seq']} "
+                        f"chunks {len(c['chunk_ids'])}")
+                if head:
+                    line += f" [{head}]"
+                if fork_of:
+                    line += f" fork-of {fork_of['doc']}@{fork_of['seq']}"
+                print(line)
+            return 0
+        if args.action == "at":
+            if args.seq is None:
+                print("history at requires --seq", file=sys.stderr)
+                return 2
+            at = t.request(dict(base, t="history_at",
+                                seq=args.seq))["at"]
+            print(json.dumps(at, indent=2))
+            return 0
+        if args.action == "fork":
+            res = t.request(dict(base, t="history_fork", seq=args.seq,
+                                 new_doc=args.new_doc))["fork"]
+            print(f"forked {args.tenant}/{args.doc}@{res['fork_seq']} "
+                  f"-> {res['doc']} (base {res['version']} seq "
+                  f"{res['base_seq']}, {res['shared_chunks']} shared "
+                  f"chunk(s), {res['tail_ops']} tail op(s))")
+            return 0
+        # integrate: args.doc IS the fork
+        res = t.request(dict(base, t="history_integrate"))["integrate"]
+        print(f"integrated {res['ops']} op(s) from {res['fork']} "
+              f"into {res['parent']}")
+        return 0
+    finally:
+        t.close()
+
+
 def main(argv=None) -> int:
     # the connection options are accepted before OR after the
     # subcommand (`admin --port P slo` and `admin slo --port P` both
@@ -513,6 +565,22 @@ def main(argv=None) -> int:
     s.add_argument("--fleet", action="store_true",
                    help="sum placement counters across every reachable "
                         "core instead of just the queried one")
+    s = sub.add_parser("history", parents=[common],
+                       help="doc history plane: commit log, fork a doc "
+                            "at a seq, resolve a point-in-time read, "
+                            "integrate a fork back into its parent")
+    s.add_argument("action", choices=["log", "fork", "at", "integrate"])
+    s.add_argument("tenant")
+    s.add_argument("doc", help="the doc (for integrate: the FORK doc)")
+    s.add_argument("--seq", type=int, default=None,
+                   help="fork/read-at sequence number (fork default: "
+                        "head)")
+    s.add_argument("--new-doc", default=None,
+                   help="fork target doc id (default: generated)")
+    s.add_argument("-n", type=int, default=20,
+                   help="commits to list (log; 0 = all)")
+    s.add_argument("--token", default=None,
+                   help="tenant JWT when tenancy is enforcing")
     s = sub.add_parser("migrate", parents=[common],
                        help="live-migrate a doc's partition to another "
                             "core (point --port at the current owner)")
@@ -563,6 +631,8 @@ def main(argv=None) -> int:
             print(d)
     elif args.cmd == "placement":
         return _placement(args)
+    elif args.cmd == "history":
+        return _history_cmd(args)
     elif args.cmd == "migrate":
         reply = _request(args, {"t": "admin_migrate_doc",
                                 "tenant": args.tenant, "doc": args.doc,

@@ -19,6 +19,7 @@ from typing import Callable, Iterable, Optional
 
 from ..driver import network as _network
 from ..service.broadcaster import BroadcasterLambda
+from ..service.history_plane import HistoryPlane
 from .plane import FaultPlane
 
 
@@ -53,6 +54,9 @@ def install(plane: FaultPlane, *, server=None, appliers: Iterable = (),
     if server is not None:
         _set(server.log, "fault_plane", plane)
         _set(BroadcasterLambda, "fault_plane", plane)
+        # the history plane is built lazily (server.history property), so
+        # the hook must sit on the class like the broadcaster's
+        _set(HistoryPlane, "fault_plane", plane)
     for applier in appliers:
         _set(applier, "fault_plane", plane)
     for stage in stages:

@@ -42,6 +42,7 @@ BOUNDARY_CLASSES = {
     "applier": "device",
     "snapshot": "snapshot",
     "placement": "placement",
+    "history": "history",
 }
 
 

@@ -66,7 +66,7 @@ DS_ID = "default"
 CHANNEL_ID = "text"
 
 BOUNDARY_REQUIRED = ("network", "log", "fanout", "stage", "device",
-                     "snapshot")
+                     "snapshot", "history")
 
 _TEXT_POOL = "abcdefgh" * 4
 
@@ -409,6 +409,15 @@ def _schedule_phase_a(plane: FaultPlane) -> None:
     # skip-by-seq absorbs the already-applied window (no double-apply)
     plane.rule("applier.stage.staged", "crash", at=2)
     plane.rule("applier.stage.inflight", "crash", at=3)
+    # crash-mid-fork, BOTH windows: "commit" kills after the pending
+    # fork commit record lands but before the doc is seeded (recovery
+    # must DISCARD — the fork doc does not exist); "seeded" kills after
+    # seeding but before the ref flips (recovery must ADOPT — the doc
+    # is durable, only the refs are missing). Either way no ref dangles.
+    plane.rule("history.fork", "crash", at=1,
+               when=lambda ctx: ctx.get("stage") == "commit")
+    plane.rule("history.fork", "crash", at=1,
+               when=lambda ctx: ctx.get("stage") == "seeded")
 
 
 def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
@@ -464,6 +473,10 @@ def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
                             c.reconnect()
                     server.drain()
 
+            # crash-mid-fork drill: tear a fork at both windows and
+            # require restart recovery to adopt-or-discard atomically
+            _exercise_fork_crash(server, counters)
+
             # settle: stop injecting, resolve every open submission
             plane.disarm()
             for _ in range(6):
@@ -495,6 +508,69 @@ def run_phase_a(seed: int, counters: Counters, rounds: int = 24,
     finally:
         uninstall()
     return plane, monitor
+
+
+def _exercise_fork_crash(server, counters: Counters) -> None:
+    """Tear a fork at BOTH crash windows (scheduled in
+    ``_schedule_phase_a``), simulate the restart by rebuilding the
+    history plane over the same durable records, and require recovery
+    to adopt-or-discard atomically. A dangling ref — a fork commit no
+    ref covers and no discard marker abandons — is an invariant
+    violation, as is adopting an unseeded fork or discarding a seeded
+    one."""
+    from ..service.history_plane import (
+        MAIN_REF,
+        HistoryPlane,
+        fork_pin_ref,
+    )
+    from ..service.service_summarizer import (
+        HostReplicaSource,
+        ServiceSummarizer,
+    )
+
+    # forks boot from committed generations: put one on the graph
+    ServiceSummarizer(server, HostReplicaSource(server)).summarize_doc(
+        TENANT, DOC)
+
+    def torn_fork(new_doc: str) -> None:
+        try:
+            server.history.fork(TENANT, DOC, new_doc=new_doc)
+        except SimulatedCrash:
+            return
+        raise InvariantViolation(
+            f"scheduled crash-mid-fork of {new_doc} did not fire")
+
+    # window 1: commit record written, doc NOT seeded → must discard
+    torn_fork("soak-fork-torn")
+    rebooted = HistoryPlane(server)  # the restart: fresh in-memory state
+    fstore = rebooted._store(TENANT, "soak-fork-torn")
+    pstore = rebooted._store(TENANT, DOC)
+    dangling = [cid for cid in fstore.commits
+                if cid not in set(fstore.refs.values())
+                and cid not in fstore.discarded]
+    if dangling:
+        raise InvariantViolation(
+            f"fork recovery left dangling commits {dangling}")
+    if fstore.refs or fork_pin_ref(TENANT, "soak-fork-torn") in pstore.refs:
+        raise InvariantViolation(
+            "recovery adopted an UNSEEDED fork (refs exist for a doc "
+            "with no durable v0)")
+    counters.inc("chaos.recovered.history_recover")
+
+    # window 2: doc seeded, refs NOT flipped → must adopt
+    torn_fork("soak-fork-seeded")
+    rebooted = HistoryPlane(server)
+    fstore = rebooted._store(TENANT, "soak-fork-seeded")
+    pstore = rebooted._store(TENANT, DOC)
+    if MAIN_REF not in fstore.refs \
+            or fork_pin_ref(TENANT, "soak-fork-seeded") not in pstore.refs:
+        raise InvariantViolation(
+            "recovery discarded a SEEDED fork (durable v0 exists but "
+            "refs were not restored)")
+    # the adopted fork must actually serve history reads post-restart
+    head = fstore.commits[fstore.refs[MAIN_REF]]
+    rebooted.replay_read(TENANT, "soak-fork-seeded", head["base_seq"])
+    counters.inc("chaos.recovered.history_recover")
 
 
 def _oracle_fingerprint(server) -> str:
@@ -1061,6 +1137,10 @@ def _cross_check(counters: Counters) -> None:
         ("chaos.injected.snapshot.chunk.drop", "boot.snapshot.fallback"),
         ("chaos.injected.snapshot.upload.crash",
          "chaos.recovered.summary_retry"),
+        # a crash mid-fork (either window) recovers through the history
+        # plane's adopt-or-discard pass on the next load
+        ("chaos.injected.history.fork.crash",
+         "chaos.recovered.history_recover"),
     ]
     problems = []
     for injected, recovered in expectations:

@@ -19,6 +19,7 @@ from .definitions import (
     DocumentServiceFactory,
     DocumentStorage,
 )
+from .history import HistoryClient, LocalHistoryClient, NetworkHistoryClient
 from .local import LocalDocumentServiceFactory
 from .network import NetworkDocumentServiceFactory
 
@@ -28,6 +29,9 @@ __all__ = [
     "DocumentService",
     "DocumentServiceFactory",
     "DocumentStorage",
+    "HistoryClient",
+    "LocalHistoryClient",
+    "NetworkHistoryClient",
     "LocalDocumentServiceFactory",
     "NetworkDocumentServiceFactory",
 ]

@@ -85,6 +85,14 @@ class DocumentService(ABC):
     @abstractmethod
     def connect_to_storage(self) -> DocumentStorage: ...
 
+    def history(self):
+        """History-plane client for this document (commit log, fork,
+        point-in-time replay, integrate) — see driver/history.py. Not
+        abstract: drivers without a history surface (file, replay) keep
+        working and refuse here."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no history surface")
+
 
 class DocumentServiceFactory(ABC):
     """Resolves a document URL/id to a DocumentService

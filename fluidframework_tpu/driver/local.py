@@ -206,6 +206,11 @@ class LocalDocumentService(DocumentService):
     def connect_to_storage(self):
         return self._server.storage(self._tenant, self._doc)
 
+    def history(self):
+        from .history import LocalHistoryClient
+
+        return LocalHistoryClient(self._server, self._tenant, self._doc)
+
 
 class LocalDocumentServiceFactory(DocumentServiceFactory):
     def __init__(self, server: LocalServer):

@@ -104,6 +104,9 @@ class _Transport:
         # (content-addressed, so the hash — not a rid — is the key; same
         # same-thread ordering guarantee as _blocks)
         self._snap_chunks: dict[str, bytes] = {}
+        # rid → decoded history commits from FT_HISTORY pushes (the
+        # history_log listing; same same-thread ordering as _blocks)
+        self._history: dict[int, list] = {}
         self._pending_cv = threading.Condition()
         self._push_handlers: dict[str, Callable[[dict], None]] = {}
         # binary ops batches bypass the dict layer entirely
@@ -189,12 +192,14 @@ class _Transport:
                 timeout=self.timeout)
             if not ok or rid not in self._pending:
                 self._blocks.pop(rid, None)
+                self._history.pop(rid, None)
                 raise ConnectionError(
                     f"no reply for {frame.get('t')} (connection "
                     f"{'closed' if self._closed else 'timed out'})")
             reply = self._pending.pop(rid)
         if reply.get("t") == "error":
             self._blocks.pop(rid, None)
+            self._history.pop(rid, None)
             if reply.get("code") == "log_truncated":
                 raise LogTruncatedError(int(reply.get("base", 0)),
                                         snapshot_seq=reply.get("snapshotSeq"))
@@ -210,6 +215,10 @@ class _Transport:
         get_snapshot_cols terminal reply."""
         chunks, self._snap_chunks = self._snap_chunks, {}
         return chunks
+
+    def take_history(self, rid: int) -> list:
+        """Claim the decoded history commits pushed for ``rid``."""
+        return self._history.pop(rid, [])
 
     # ------------------------------------------------------------ receiving
 
@@ -276,6 +285,13 @@ class _Transport:
                         # after, on this same thread)
                         brid, msgs = binwire.read_cols_deltas(body)
                         self._blocks.setdefault(brid, []).extend(msgs)
+                        continue
+                    if body[1] == binwire.FT_HISTORY:
+                        # rid-tagged history commit (the history_log
+                        # listing): decode through the refgraph codec
+                        # and stage for the requester
+                        hrid, commit = binwire.decode_history_commit(body)
+                        self._history.setdefault(hrid, []).append(commit)
                         continue
                     if body[1] == binwire.FT_PRESENCE:
                         # coalesced presence batch: one frame, N signals
@@ -975,6 +991,11 @@ class NetworkDocumentService(DocumentService):
         return NetworkStorage(self._rpc_transport(), self._tenant,
                               self._doc, self._token_provider,
                               cache=self._cache, counters=self.counters)
+
+    def history(self):
+        from .history import NetworkHistoryClient
+
+        return NetworkHistoryClient(self)
 
 
 class NetworkDocumentServiceFactory(DocumentServiceFactory):

@@ -298,6 +298,19 @@ class Loader:
                          code_loader=self._code_loader,
                          auto_reconnect=self._auto_reconnect).load(connect)
 
+    def resolve_at(self, tenant_id: str, document_id: str,
+                   seq: int) -> Container:
+        """Resolve a POINT-IN-TIME read: a read-only offline container
+        of the doc as of ``seq``, booted from the nearest committed
+        summary at or below it plus a bounded history-backed tail
+        backfill (see loader/history_boot.py)."""
+        from .history_boot import open_at
+
+        service = self._factory.create_document_service(tenant_id,
+                                                        document_id)
+        return open_at(service.history(), seq,
+                       runtime_factory=self._runtime_factory)
+
     def create_detached(self, tenant_id: str, document_id: str) -> Container:
         """A container that lives entirely client-side until ``attach()``
         (ref: container.ts:510 detached create → attach). Build the

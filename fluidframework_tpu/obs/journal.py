@@ -77,6 +77,11 @@ KINDS = {
     "summary.commit": "summarizer committed a summary",
     "flight.dump": "flight recorder wrote a dump",
     "operator.command": "operator-issued admin command",
+    "history.commit": "history plane recorded a commit (ref advanced)",
+    "history.fork": "doc forked from a parent commit",
+    "history.integrate": "fork tail integrated back into its parent",
+    "history.ref.recover": "recovery adopted/discarded a pending fork",
+    "history.gc": "chunk GC swept unreferenced snapshot chunks",
 }
 
 

@@ -90,6 +90,7 @@ FT_COLS_DELTAS = 9
 FT_COLS_SNAP = 10
 FT_PRESENCE = 11
 FT_FPRESENCE = 12
+FT_HISTORY = 13
 
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
@@ -1042,6 +1043,31 @@ def read_snap_chunk(body: bytes):
     rid = int.from_bytes(body[2:6], "big")
     (hl,) = _U16.unpack_from(body, 6)
     return rid, body[8:8 + hl].decode("ascii"), body[8 + hl:]
+
+
+def encode_history_commit(rid: int, commit: dict) -> bytes:
+    """History commit dict → one FT_HISTORY push body, tagged with the
+    u32 request id (routing, like FT_COLS_SNAP). The commit rides as a
+    framed refgraph record so the wire exercises the same crc'd codec
+    the per-doc ref file persists."""
+    from .refgraph import encode_commit, frame_record
+    return (bytes((MAGIC, FT_HISTORY)) + rid.to_bytes(4, "big")
+            + frame_record(encode_commit(commit)))
+
+
+def decode_history_commit(body: bytes):
+    """FT_HISTORY body → (rid, commit dict). Raises on a torn record —
+    the wire is a reliable stream, unlike the ref file's tail."""
+    from .refgraph import scan_records
+    rid = int.from_bytes(body[2:6], "big")
+    records, clean = scan_records(body[6:])
+    if len(records) != 1 or clean != len(body) - 6:
+        raise ValueError("malformed FT_HISTORY body")
+    rec = records[0]
+    if rec.get("t") != "commit":
+        raise ValueError("FT_HISTORY body is not a commit record")
+    rec.pop("t", None)
+    return rid, rec
 
 
 # --------------------------------------------------- gateway byte rewrites

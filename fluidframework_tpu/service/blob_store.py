@@ -53,12 +53,20 @@ class DbBlobStore:
     def has(self, blob_id: str) -> bool:
         return self._db.find_one(self._col, blob_id) is not None
 
+    def delete(self, blob_id: str) -> bool:
+        """Unlink one blob (history-plane chunk GC). Returns whether it
+        existed. ONLY the GC may call this — deletion is safe exactly
+        when no ref-reachable commit names the chunk."""
+        col = self._db.collection(self._col)
+        return col.pop(blob_id, None) is not None
+
 
 class NativeBlobStore:
     def __init__(self, directory: str):
         from ..native import NativeChunkStore
 
         self._cas = NativeChunkStore(directory)
+        self._dir = directory
         self.stats = BlobStoreStats()
 
     def put(self, content: bytes) -> str:
@@ -77,6 +85,19 @@ class NativeBlobStore:
 
     def has(self, blob_id: str) -> bool:
         return self._cas.has(blob_id)
+
+    def delete(self, blob_id: str) -> bool:
+        """Unlink one blob from the sha-fan-out object layout
+        (``dir/aa/rest``) — the native store exposes no remove, and GC
+        runs host-side anyway. Returns whether the blob existed."""
+        import os
+
+        path = os.path.join(self._dir, blob_id[:2], blob_id[2:])
+        try:
+            os.unlink(path)
+            return True
+        except FileNotFoundError:
+            return False
 
     def close(self) -> None:
         self._cas.close()

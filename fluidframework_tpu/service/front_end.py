@@ -481,6 +481,14 @@ class _ClientSession:
                 self._check_rpc_auth(
                     frame, write=t in ("write_blob", "upload_summary"))
                 self._handle_storage(t, frame, rid)
+            elif t in ("history_log", "history_at", "history_deltas"):
+                self._check_rpc_auth(frame, write=False)
+                self._handle_history(t, frame, rid)
+            elif t in ("history_fork", "history_integrate"):
+                # fork births a doc and integrate submits ops: both
+                # mutate, so they ride the write scope like upload_summary
+                self._check_rpc_auth(frame, write=True)
+                self._handle_history(t, frame, rid)
             elif t in ("fconnect", "fsubmit", "fsignal", "fdisconnect"):
                 self._handle_gateway(t, frame, rid)
             elif t in ("admin_status", "admin_docs", "admin_tenants",
@@ -1003,6 +1011,44 @@ class _ClientSession:
                 "rid": rid,
                 "id": storage.upload_summary(frame["summary"],
                                              frame.get("parent"))})
+
+    @loop_only("core")
+    def _handle_history(self, t: str, frame: dict, rid) -> None:
+        """Doc history doors onto the history plane. ``history_log``
+        pushes each commit as one binary FT_HISTORY frame (the same
+        refgraph codec the ref files use, so the driver exercises the
+        torn-tail framing end to end) then a JSON terminal carrying the
+        refs and the count — same wire, same thread, ordering holds.
+        The other doors are plain JSON request/reply. Historical boots
+        themselves ride the EXISTING storage doors (``get_tree`` with an
+        explicit version) so replay adds no second snapshot path."""
+        tenant, doc = frame["tenant"], frame["doc"]
+        history = self.front.server_for(tenant, doc).history
+        if t == "history_log":
+            commits = history.log(tenant, doc, frame.get("count"))
+            for c in commits:
+                self.push_raw(binwire.frame(
+                    binwire.encode_history_commit(int(rid), c)))
+            self.push("history", {
+                "rid": rid, "commits": len(commits),
+                "refs": history.refs(tenant, doc)})
+        elif t == "history_at":
+            self.push("history", {
+                "rid": rid,
+                "at": history.replay_read(tenant, doc, frame["seq"])})
+        elif t == "history_deltas":
+            msgs = history.read_deltas(
+                tenant, doc, frame["from"], frame["to"])
+            self.push("history", {
+                "rid": rid, "msgs": [message_to_dict(m) for m in msgs]})
+        elif t == "history_fork":
+            res = history.fork(tenant, doc, at_seq=frame.get("seq"),
+                               new_doc=frame.get("new_doc"))
+            self.push("history", {"rid": rid, "fork": res})
+        elif t == "history_integrate":
+            res = history.integrate(tenant, doc,
+                                    batch=frame.get("batch", 64))
+            self.push("history", {"rid": rid, "integrate": res})
 
     def _reply_offloop(self, rid, work, reply) -> None:
         """Run ``work()`` on the default executor and push

@@ -182,6 +182,11 @@ class LocalServer:
         self.pubsub = PubSub()
         # content-addressed blob store: native C++ chunk store when given
         # a directory (the gitrest/libgit2 role), else db-backed
+        self.storage_dir = storage_dir
+        # doc history plane (commit/ref graph over snapshot generations):
+        # constructed on first use — the summarizer's commit hook, the
+        # history doors, and chunk GC all go through it
+        self._history = None
         if storage_dir is not None:
             from .blob_store import NativeBlobStore
 
@@ -242,6 +247,16 @@ class LocalServer:
         # rebalancer's windowed heat series with it, and None means
         # single-pipeline: no heat accounting, nowhere to rebalance
         self.part_k = None
+
+    @property
+    def history(self):
+        """The doc history plane (service/history_plane.py): commit/ref
+        graph, fork, point-in-time replay, integrate, chunk GC."""
+        if self._history is None:
+            from .history_plane import HistoryPlane
+
+            self._history = HistoryPlane(self)
+        return self._history
 
     def seal(self) -> None:
         """Migration fence point: refuse new submits (they bounce with a

@@ -71,6 +71,13 @@ class ScriptoriumLambda:
         abatch = envelope.get("abatch")
         if abatch is not None:
             first, n = abatch.base_seq, abatch.n
+            if not log and last == 0 and first > 1:
+                # fork adoption: a forked doc's deltas topic begins at its
+                # fork base + 1, not 1 — the topic's first record defines
+                # the base (normal docs always open at seq 1), otherwise a
+                # durable-log replay would rebuild the tail at positions
+                # that violate the dense invariant
+                last = doc["base"] = first - 1
             if first == last + 1:  # hot path: ONE list-repeat, no per-op
                 log.extend([abatch] * n)
             elif first + n - 1 > last:
@@ -80,6 +87,9 @@ class ScriptoriumLambda:
         if batch is None:
             batch = [envelope["message"]]
         first = batch[0].sequence_number
+        if not log and last == 0 and first > 1:
+            # fork adoption (see the abatch branch above)
+            last = doc["base"] = first - 1
         if first == last + 1:  # the hot path: append in arrival order
             log.extend(batch)
             return

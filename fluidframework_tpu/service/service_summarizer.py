@@ -200,6 +200,14 @@ class ServiceSummarizer:
         # the scribe's ref-update path so the version reaches the durable
         # versions topic (survives process death) and retention advances
         scribe.commit_version(version_id, scribe.protocol.sequence_number)
+        # history plane hook: the committed generation becomes a commit
+        # node in the doc's ref graph (refs/main advances; forks and
+        # time-travel resolve against these)
+        history = getattr(self.server, "history", None)
+        if history is not None:
+            history.record_commit(
+                tenant_id, document_id, version_id,
+                scribe.protocol.sequence_number, chunk_ids)
         # the gate pass proved full coverage — anchor the slot so the doc
         # stays summarizable after this commit's own retention truncation
         self.applier.mark_anchored(tenant_id, document_id)

@@ -65,8 +65,10 @@ ALLOWED = {
     "service": {"protocol", "utils", "ops", "parallel", "mergetree",
                 "driver", "native", "obs"},
     "native": {"utils"},
+    # obs: the replay tool reports history-first vs legacy whole-log
+    # boots into the shared metrics registry (history.replay.legacy)
     "replay": {"loader", "driver", "runtime", "dds", "protocol", "utils",
-               "service", "mergetree"},
+               "service", "mergetree", "obs"},
     # the fault-injection plane sits beside the service: it may reach the
     # seams it arms (service/driver) and the layers they expose, but NO
     # production layer may import chaos back — the seams stay duck-typed

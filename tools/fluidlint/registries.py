@@ -171,6 +171,23 @@ LOCKED_FAMILIES = {
                                "obs.journal.bytes",
                                "obs.journal.errors",
                                "obs.journal.rotations"}),
+    # the doc history plane: the net-smoke history gate and the
+    # fork-storm bench counter-assert fork boots / replay reads /
+    # integrate ops on these exact names (service/history_plane.py;
+    # history.replay.legacy is the replay tool's whole-log-replay
+    # deprecation gauge, replay/tool.py)
+    "history.": frozenset({"history.commit.records",
+                           "history.fork.boots",
+                           "history.fork.tail_ops",
+                           "history.replay.reads",
+                           "history.replay.log_scans",
+                           "history.replay.legacy",
+                           "history.integrate.sessions",
+                           "history.integrate.ops",
+                           "history.gc.scanned",
+                           "history.gc.pinned",
+                           "history.gc.deleted",
+                           "history.ref.recovered"}),
 }
 
 
@@ -216,6 +233,7 @@ FT_CODECS = {
     "FT_COLS_SNAP": ("snap_chunk_body", "read_snap_chunk"),
     "FT_PRESENCE": ("encode_presence", "decode_presence"),
     "FT_FPRESENCE": ("encode_presence", "decode_presence"),
+    "FT_HISTORY": ("encode_history_commit", "decode_history_commit"),
 }
 
 

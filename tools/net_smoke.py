@@ -44,7 +44,12 @@ amortization points of the socket tier (see ARCHITECTURE.md
   subscribers — ``fanout.relay.splices`` must rise at BOTH levels,
   ``presence.lane.coalesced`` and ``session.readonly.connects`` must
   rise at the core, and ``fanout.relay.encodes`` must stay 0 (zero
-  re-encode above the first gateway level).
+  re-encode above the first gateway level);
+- the doc history plane over sockets: summarize a live doc, fork it
+  through the history door, read a historical seq through a read-only
+  replay container, edit the fork and integrate the edit back into the
+  parent — ``history.fork.boots``, ``history.replay.reads`` and
+  ``history.integrate.ops`` must all rise.
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -372,6 +377,107 @@ def relay_gate() -> dict:
         front.stop()
 
 
+def history_gate() -> dict:
+    """Doc history plane over sockets, in process: fork a live doc at
+    its newest commit, time-travel a read into the pre-fork state,
+    integrate a fork edit back through the parent's total order. Every
+    leg goes through the front end's history doors; the service-tier
+    counters must account for the boot, the historical read and the
+    integrated op — a refactor that silently reroutes any of them onto
+    the whole-log path fails here, not in the next bench run."""
+    from fluidframework_tpu.driver import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.obs import tier_snapshot
+    from fluidframework_tpu.service import LocalServer, NetworkFrontEnd
+
+    front = NetworkFrontEnd(LocalServer()).start_background()
+    containers = []
+    try:
+        factory = NetworkDocumentServiceFactory("127.0.0.1", front.port)
+        loader = Loader(factory)
+        writer = loader.resolve("smoke", "histdoc")
+        containers.append(writer)
+        sstr = writer.runtime.create_data_store(
+            "default").create_channel("text", "shared-string")
+        for i in range(30):
+            sstr.insert_text(0, f"h{i:02d} ")
+        if not wait_for(lambda: writer.runtime.pending.count == 0):
+            raise AssertionError("history gate: writer never quiesced")
+        svc = factory.create_document_service("smoke", "histdoc")
+        svc._rpc_transport().request(
+            {"t": "admin_summarize", "tenant": "smoke", "doc": "histdoc"})
+        mid_text = sstr.get_text()
+        mid_seq = svc._rpc_transport().request(
+            {"t": "admin_status", "tenant": "smoke",
+             "doc": "histdoc"})["status"]["seq"]
+        for i in range(8):
+            sstr.insert_text(0, f"t{i} ")
+        if not wait_for(lambda: writer.runtime.pending.count == 0):
+            raise AssertionError("history gate: tail edits never acked")
+        tail_text = sstr.get_text()
+
+        before = tier_snapshot("service")
+
+        # time-travel: a read-only container at the pre-tail commit must
+        # reproduce the doc exactly as it stood at that seq
+        hist = loader.resolve_at("smoke", "histdoc", mid_seq)
+        containers.append(hist)
+        got = (hist.runtime.get_data_store("default")
+               .get_channel("text").get_text())
+        if got != mid_text:
+            raise AssertionError(
+                f"history gate: time-travel read at seq {mid_seq} drifted "
+                f"({len(got)} chars vs {len(mid_text)})")
+
+        # near-free fork: boots from the parent's chunks, converges on
+        # the parent's full tail, then diverges with one edit
+        res = svc.history().fork(new_doc="histfork")
+        if res.get("shared_chunks", 0) <= 0:
+            raise AssertionError(
+                "history gate: fork shared no chunks with its parent "
+                f"({res})")
+        fork = loader.resolve("smoke", "histfork")
+        containers.append(fork)
+        fstr = fork.runtime.get_data_store("default").get_channel("text")
+        if not wait_for(lambda: fstr.get_text() == tail_text):
+            raise AssertionError(
+                "history gate: fork never converged on the parent's "
+                f"tail ({len(fstr.get_text())} vs {len(tail_text)})")
+        fstr.insert_text(0, "FORK ")
+        if not wait_for(lambda: fstr.get_text().startswith("FORK ")):
+            raise AssertionError("history gate: fork edit never acked")
+
+        # integrate: the fork's post-base tail replays through the
+        # parent's ordinary total order (CRDT merge, no special path)
+        out = factory.create_document_service(
+            "smoke", "histfork").history().integrate()
+        if out.get("ops") != 1:
+            raise AssertionError(
+                f"history gate: integrate replayed {out.get('ops')} "
+                "op(s), wanted exactly 1")
+        if not wait_for(lambda: sstr.get_text().startswith("FORK ")):
+            raise AssertionError(
+                "history gate: integrated edit never reached the parent")
+
+        after = tier_snapshot("service")
+
+        def _delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        return {
+            "history.fork.boots": _delta("history.fork.boots"),
+            "history.replay.reads": _delta("history.replay.reads"),
+            "history.integrate.ops": _delta("history.integrate.ops"),
+        }
+    finally:
+        for c in containers:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        front.stop()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from fluidframework_tpu.driver.network import (
@@ -686,6 +792,14 @@ def main() -> int:
     # re-encodes above the first gateway level
     try:
         checks.update(relay_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+
+    # doc history plane over sockets: fork a live doc, time-travel a
+    # read, integrate one fork edit back — all three counters nonzero
+    try:
+        checks.update(history_gate())
     except AssertionError as e:
         print(f"net_smoke: FAIL — {e}", file=sys.stderr)
         return 1
