@@ -1928,6 +1928,319 @@ def bench_net_fork_storm() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_net_cold_storm() -> dict:
+    """Fleet cold-start storm: kill a 2-core subprocess fleet and
+    restart it from its topology spec with 1k/4k/10k docs on disk.
+
+    One ``TopologySpec`` (service/topology.py) IS the fleet: cores +
+    storage tier + admission knobs, restarted with ``Fleet.restart()``
+    — no per-core argv reconstruction. Docs are seeded incrementally
+    (each axis point reuses the previous point's corpus), summarized
+    through the service summarizer and checkpointed by the cores' own
+    2 s ticker, so every boot in the restarted generation is the lazy
+    O(snapshot + durable-log tail) path. Per axis point:
+
+    - **cold-boot time**: kill -9 → restart from spec → first-route
+      every doc (raw readonly connects, ``boot_pending`` replies
+      retried after their ``retryAfterMs``) until the whole corpus
+      serves — the client-driven boot storm, wall-clocked end to end;
+    - **time-to-first-edit**: one sampled cold doc boots through a
+      real Loader and acks one edit, timed from connect start — what a
+      reconnecting user feels;
+    - **warm-doc ack p99 during the storm** vs the same probe on a
+      quiet fleet: the admission gate's whole point is that docs
+      already booted keep their latency while thousands of cold boots
+      queue behind the token bucket (asserted ≤ 1.5x unless
+      host_limited — on a 1-CPU host the storm time-slices the probe);
+    - **the lazy contract, in-bench (hard)**: ``admin_boot_status``
+      summed over the restarted cores must show ZERO
+      ``boot.part.full_replay`` — a missing summary or checkpoint
+      fails the bench here, not in a latency mystery.
+    """
+    import os
+    import shutil
+    import socket as _socket
+    import tempfile
+    import threading
+    import time as _time
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+        _Transport,
+    )
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.placement_plane import EpochTable
+    from fluidframework_tpu.service.stage_runner import doc_partition
+    from fluidframework_tpu.service.topology import Fleet, default_spec
+
+    axis = [1000, 4000, 10000]
+    n_parts = 8
+    warm_docs = [f"warm{i}" for i in range(4)]
+    host_limited = (os.cpu_count() or 1) < 4
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3)
+
+    def fr(obj):
+        body = json.dumps(obj, separators=(",", ":")).encode()
+        return len(body).to_bytes(4, "big") + body
+
+    def read_frame(s, buf):
+        while True:
+            if len(buf[0]) >= 4:
+                n = int.from_bytes(buf[0][:4], "big")
+                if len(buf[0]) >= 4 + n:
+                    body, buf[0] = buf[0][4:4 + n], buf[0][4 + n:]
+                    return json.loads(body)
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("cold-storm socket closed")
+            buf[0] += chunk
+
+    def chanop(cseq, i):
+        return {"clientSequenceNumber": cseq,
+                "referenceSequenceNumber": 0, "type": "op",
+                "contents": {"kind": "chanop", "address": "default",
+                             "contents": {"address": "text",
+                                          "contents": {"type": 0,
+                                                       "pos": 0,
+                                                       "text": f"s{i} "}}}}
+
+    root = tempfile.mkdtemp(prefix="bench-cold-storm-")
+    # lease_ttl: long enough that a core stalled by storm work on a
+    # time-sliced host doesn't lose partitions mid-measurement (churn
+    # is the chaos drill's subject, not this bench's); restart still
+    # only waits one ttl for the killed generation's leases to stale
+    spec = default_spec(os.path.join(root, "fleet"), n_cores=2,
+                        n_partitions=n_parts, lease_ttl=6.0,
+                        summarize_every=10 ** 6)
+    fl = Fleet(spec, subprocess=True).start()
+    fl.wait_claimed()
+    table = EpochTable.for_shard_dir(spec.shard_dir)
+
+    def port_for(doc):
+        k = doc_partition("bench", doc, n_parts)
+        rec = table.read()["parts"][str(k)]
+        return int(rec["addr"].rsplit(":", 1)[1])
+
+    def resolve_net(doc):
+        """Loader boot at the doc's CURRENT owner; ownership can churn
+        for a beat after wait_claimed (the chaos drill's reroute
+        idiom), so re-read the table and retry on routing refusals."""
+        deadline = _time.monotonic() + 30.0
+        while True:
+            try:
+                return Loader(NetworkDocumentServiceFactory(
+                    "127.0.0.1", port_for(doc))).resolve("bench", doc)
+            except (RuntimeError, ConnectionError) as e:
+                if ("not the owner" not in str(e)
+                        or _time.monotonic() >= deadline):
+                    raise
+                _time.sleep(0.2)
+
+    def seed(doc):
+        deadline = _time.monotonic() + 30.0
+        while True:
+            s = _socket.create_connection(("127.0.0.1", port_for(doc)),
+                                          timeout=30)
+            buf = [b""]
+            s.sendall(fr({"t": "connect", "tenant": "bench", "doc": doc,
+                          "rid": 1, "bin": 0}))
+            reply = read_frame(s, buf)
+            while reply.get("rid") != 1:
+                reply = read_frame(s, buf)
+            if reply.get("t") == "error":
+                # ownership can churn for a beat around a takeover:
+                # re-read the table (port_for) and retry at the owner
+                s.close()
+                assert ("not the owner" in str(reply.get("message"))
+                        and _time.monotonic() < deadline), \
+                    f"seed refused: {reply}"
+                _time.sleep(0.2)
+                continue
+            s.sendall(fr({"t": "submit",
+                          "ops": [chanop(i + 1, i) for i in range(4)]}))
+            s.close()
+            return
+
+    def route_cold(doc):
+        """One first route: raw readonly connect, boot_pending replies
+        retried after their advertised backoff. Returns retry count."""
+        parked = 0
+        while True:
+            s = _socket.create_connection(("127.0.0.1", port_for(doc)),
+                                          timeout=30)
+            buf = [b""]
+            s.sendall(fr({"t": "connect", "tenant": "bench", "doc": doc,
+                          "rid": 1, "bin": 0, "readonly": 1}))
+            reply = read_frame(s, buf)
+            while reply.get("rid") != 1:
+                reply = read_frame(s, buf)
+            s.close()
+            if reply.get("t") != "error":
+                return parked
+            if "not the owner" in str(reply.get("message", "")):
+                _time.sleep(0.2)  # reroute: the loop re-reads the table
+                continue
+            assert reply.get("code") == "boot_pending", \
+                f"cold route refused: {reply}"
+            parked += 1
+            _time.sleep((reply.get("retryAfterMs") or 50) / 1000)
+
+    def summarize_all(docs):
+        trans = {p: _Transport("127.0.0.1", p, timeout=30.0)
+                 for p in fl.core_ports.values()}
+        try:
+            for doc in docs:
+                deadline = _time.monotonic() + 30.0
+                while True:
+                    try:
+                        trans[port_for(doc)].request_rid(
+                            {"t": "admin_summarize", "tenant": "bench",
+                             "doc": doc})
+                        break
+                    except RuntimeError as e:
+                        if ("not the owner" not in str(e)
+                                or _time.monotonic() >= deadline):
+                            raise
+                        _time.sleep(0.2)
+        finally:
+            for t in trans.values():
+                t.close()
+
+    def boot_totals():
+        tot = {}
+        for p in fl.core_ports.values():
+            t = _Transport("127.0.0.1", p, timeout=30.0)
+            try:
+                _, rep = t.request_rid({"t": "admin_boot_status"})
+            finally:
+                t.close()
+            for k, v in rep["boot"]["counters"].items():
+                tot[k] = tot.get(k, 0) + v
+        return tot
+
+    def warm_probe(sstrs, lats, stop=None):
+        """Round-robin timed edits on the warm docs until ``stop`` is
+        set (or one pass when no stop event is given)."""
+        while True:
+            for c, sstr in sstrs:
+                t0 = _time.perf_counter()
+                sstr.insert_text(0, "w")
+                deadline = _time.monotonic() + 60.0
+                while (c.runtime.pending.count
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.0005)
+                assert c.runtime.pending.count == 0, \
+                    "warm probe op never acked during the storm"
+                lats.append((_time.perf_counter() - t0) * 1e3)
+                _time.sleep(0.002)
+            if stop is None or stop.is_set():
+                return
+
+    rows = []
+    seeded = 0
+    try:
+        for doc in warm_docs:
+            seed(doc)
+        for target in axis:
+            for d in range(seeded, target):
+                seed(f"cs{d}")
+            seeded = target
+            summarize_all([f"cs{d}" for d in range(target)] + warm_docs)
+            _time.sleep(3.0)  # two checkpoint-ticker passes
+
+            fl.restart()
+            fl.wait_claimed()
+
+            # warm docs boot first, then a quiet-fleet baseline probe
+            warm = []
+            for doc in warm_docs:
+                c = resolve_net(doc)
+                warm.append((c, c.runtime.get_data_store(
+                    "default").get_channel("text")))
+            baseline: list = []
+            for _ in range(15):
+                warm_probe(warm, baseline)
+
+            # the storm: first-route every cold doc, wall-clocked;
+            # doc 0 boots through a real Loader (time-to-first-edit)
+            parked = [0]
+            tti = [0.0]
+
+            def storm(n=target):
+                t0 = _time.perf_counter()
+                c = resolve_net("cs0")
+                ds = c.runtime.data_stores
+                sstr = (c.runtime.get_data_store("default")
+                        .get_channel("text")
+                        if "default" in ds else
+                        c.runtime.create_data_store(
+                            "default").create_channel(
+                                "text", "shared-string"))
+                sstr.insert_text(0, "first ")
+                while c.runtime.pending.count:
+                    _time.sleep(0.0005)
+                tti[0] = (_time.perf_counter() - t0) * 1e3
+                c.close()
+                for d in range(1, n):
+                    parked[0] += route_cold(f"cs{d}")
+
+            stop = threading.Event()
+            storm_lats: list = []
+            prober = threading.Thread(
+                target=warm_probe, args=(warm, storm_lats, stop))
+            t0 = _time.monotonic()
+            prober.start()
+            try:
+                storm()
+            finally:
+                stop.set()
+                prober.join()
+            cold_boot_s = _time.monotonic() - t0
+
+            tot = boot_totals()
+            replays = tot.get("boot.part.full_replay", 0)
+            assert replays == 0, \
+                (f"{replays} doc(s) whole-log replayed at the {target} "
+                 f"point — the O(snapshot+tail) contract broke: {tot}")
+            lazy = tot.get("boot.part.lazy", 0)
+            assert lazy >= target, \
+                f"only {lazy} lazy boots for {target} docs: {tot}"
+            ratio = round(pct(storm_lats, 0.99)
+                          / max(pct(baseline, 0.99), 1e-9), 3)
+            if not host_limited:
+                assert ratio <= 1.5, \
+                    (f"warm-doc ack p99 {ratio}x baseline during the "
+                     f"{target}-doc storm (admission gate not holding)")
+            for c, _sstr in warm:
+                c.close()
+            rows.append({
+                "docs": target,
+                "cold_boot_s": round(cold_boot_s, 2),
+                "boots_per_s": round(target / cold_boot_s, 1),
+                "time_to_first_edit_ms": round(tti[0], 1),
+                "warm_p99_ack_ms_baseline": pct(baseline, 0.99),
+                "warm_p99_ack_ms_storm": pct(storm_lats, 0.99),
+                "warm_p99_vs_baseline_x": ratio,
+                "parked_retries": parked[0],
+                "boot_part_lazy": lazy,
+                "boot_part_full_replay": 0,
+            })
+        return {
+            "axis": rows,
+            "cores": 2,
+            "partitions": n_parts,
+            "host_limited": host_limited,
+            "admission": {"rate_per_s": spec.boot_rate,
+                          "burst": spec.boot_burst},
+        }
+    finally:
+        fl.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_multichip() -> dict:
     """Per-device scaling of the doc-mesh lane (tools/bench_multichip):
     docs axis 1→2→4→8 on forced host devices, in a FRESH process — XLA
@@ -1968,6 +2281,7 @@ def main() -> None:
     read_storm = bench_net_read_storm()
     rebalance_storm = bench_net_rebalance_storm()
     fork_storm = bench_net_fork_storm()
+    cold_storm = bench_net_cold_storm()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -2104,6 +2418,14 @@ def main() -> None:
                 # parent fingerprint-equal across history-first and
                 # whole-log replays at seeds 0/7/42
                 "net_fork_storm": fork_storm,
+                # fleet cold start from one topology spec: kill -9 a
+                # 2-core subprocess fleet with 1k/4k/10k docs on disk,
+                # restart from the spec, first-route the whole corpus.
+                # Cold-boot time + time-to-first-edit per point, warm-
+                # doc ack p99 during the storm vs quiet baseline, and
+                # boot.part.full_replay == 0 asserted in-bench (every
+                # boot is snapshot + durable tail, never whole log)
+                "net_cold_storm": cold_storm,
                 # per-device scaling of the doc-mesh applier lane (docs
                 # axis 1→2→4→8, forced host devices; full artifact in
                 # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh
@@ -2115,4 +2437,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) > 1:
+        # one lane by name (`python bench.py net_cold_storm`): any
+        # argless bench_* runs standalone and prints its own row
+        _fn = globals().get(f"bench_{_sys.argv[1]}")
+        if not callable(_fn):
+            _sys.exit(f"unknown bench lane: {_sys.argv[1]}")
+        print(json.dumps({_sys.argv[1]: _fn()}, indent=2, default=str))
+    else:
+        main()

@@ -21,6 +21,7 @@ one CLI against the ordering core's admin frames (front_end.py
     python -m fluidframework_tpu.admin --port P slo
     python -m fluidframework_tpu.admin placement --port P [--fleet]
     python -m fluidframework_tpu.admin placement heat --port P
+    python -m fluidframework_tpu.admin placement boot --port P [--fleet]
     python -m fluidframework_tpu.admin placement rebalance --port P
     python -m fluidframework_tpu.admin placement drain CORE --port P
     python -m fluidframework_tpu.admin migrate TENANT DOC TARGET --port P
@@ -31,7 +32,12 @@ membership (active/draining/drained), the partitions this core serves,
 the lease liveness view, and the ``placement.*`` counter snapshot
 (``--fleet`` sums the counters across every reachable core).
 ``placement heat`` fans out to every member and prints the windowed
-per-partition heat table the rebalancer plans from; ``placement
+per-partition heat table the rebalancer plans from; ``placement boot``
+shows a cold-starting core's rehydration progress — docs booted vs
+still pending per owned partition, the admission executor's state
+(rate/burst/tokens, parked boots) and the ``boot.*`` counters proving
+the lazy contract (``--fleet`` fans out to every member and prints the
+fleet totals: the operator's one-stop view mid boot storm); ``placement
 rebalance`` shows the self-driving loop's status (last plan,
 suppression counts, flap count); ``placement drain CORE`` marks a
 member draining — the loop evacuates its partitions and flips it to
@@ -179,6 +185,8 @@ def _placement(args) -> int:
         for name, v in sorted(st.get("fleet_counters", {}).items()):
             print(f"  {name} {v}")
         return 0
+    if args.action == "boot":
+        return _placement_boot(args)
     frame = {"t": "admin_placement"}
     if args.fleet:
         frame["fleet"] = True
@@ -220,6 +228,62 @@ def _placement(args) -> int:
         print(f"  lease {k}: {row}")
     for name, v in sorted(pl["counters"].items()):
         print(f"  {name} {v}")
+    return 0
+
+
+def _boot_row(owner: str, addr: str, boot: dict) -> tuple:
+    """Print one core's rehydration progress; returns its (booted,
+    pending, counters) contribution to the fleet totals."""
+    ex = boot.get("executor") or {}
+    booted = sum(p["docs_booted"] for p in boot.get("parts", []))
+    pending = sum(p["docs_pending"] for p in boot.get("parts", []))
+    print(f"core {boot.get('owner', owner)} @ {addr}  "
+          f"booted {booted} pending {pending}  "
+          f"executor rate {ex.get('rate')}/s burst {ex.get('burst')} "
+          f"tokens {ex.get('tokens')} parked {ex.get('parked', 0)}")
+    for part in boot.get("parts", []):
+        print(f"  part {part['part']}: booted {part['docs_booted']} "
+              f"pending {part['docs_pending']}")
+    for name, v in sorted((boot.get("counters") or {}).items()):
+        print(f"  {name} {v}")
+    return booted, pending, boot.get("counters") or {}
+
+
+def _placement_boot(args) -> int:
+    """Rehydration progress (``placement boot``): how far a cold core
+    is through its boot storm — per-partition booted/pending docs, the
+    admission executor's bucket, and the ``boot.*`` counters. With
+    ``--fleet``, fans out to every member and sums."""
+    if not args.fleet:
+        reply = _request(args, {"t": "admin_boot_status"})
+        boot = reply.get("boot")
+        if boot is None:
+            print("not a sharded core (no boot plane)")
+            return 1
+        _boot_row("local", f"{args.host}:{args.port}", boot)
+        return 0
+    totals: dict = {}
+    booted = pending = reached = 0
+    for owner, addr in _fleet_cores(args).items():
+        try:
+            boot = _peer_request(
+                args, addr, {"t": "admin_boot_status"})["boot"]
+        except (OSError, ValueError, RuntimeError) as e:
+            print(f"core {owner} @ {addr} unreachable: {e}")
+            continue
+        b, p, counters = _boot_row(owner, addr, boot)
+        booted += b
+        pending += p
+        reached += 1
+        for name, v in counters.items():
+            totals[name] = totals.get(name, 0) + v
+    print(f"fleet: {reached} core(s)  booted {booted} pending {pending}")
+    for name, v in sorted(totals.items()):
+        print(f"  {name} {v}")
+    if totals.get("boot.part.full_replay", 0):
+        print("WARNING: boot.part.full_replay nonzero — some doc paid "
+              "a whole-log replay (missing summary or checkpoint?)")
+        return 1
     return 0
 
 
@@ -358,6 +422,11 @@ def _bundle(args) -> int:
                 {"t": "admin_rebalance_status"})["rebalance"]
             with open(os.path.join(cdir, "rebalance.json"), "w") as f:
                 json.dump(reb, f, indent=2, default=str)
+            boot = _peer_request(
+                args, addr, {"t": "admin_boot_status"}).get("boot")
+            if boot is not None:
+                with open(os.path.join(cdir, "boot.json"), "w") as f:
+                    json.dump(boot, f, indent=2, default=str)
             j = _peer_request(args, addr, {"t": "admin_journal",
                                            "n": 1000})["journal"]
             row["journal_armed"] = j["armed"]
@@ -555,8 +624,11 @@ def main(argv=None) -> int:
                             "counters; subviews: heat / rebalance / "
                             "drain CORE")
     s.add_argument("action", nargs="?", default=None,
-                   choices=["heat", "rebalance", "drain"],
+                   choices=["heat", "rebalance", "drain", "boot"],
                    help="heat: per-core per-partition heat table; "
+                        "boot: cold-start rehydration progress "
+                        "(booted/pending docs, executor, boot.* "
+                        "counters; --fleet sums every core); "
                         "rebalance: loop status + last plan; "
                         "drain: mark CORE draining (evacuate + "
                         "decommission)")

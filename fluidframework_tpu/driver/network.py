@@ -82,6 +82,24 @@ class LogTruncatedError(RuntimeError):
         self.snapshot_seq = snapshot_seq
 
 
+class BootPendingError(RuntimeError):
+    """The doc's first route landed during a cold-start boot storm and
+    the core's rehydration executor parked it: retry after the hinted
+    backoff (the connect-side twin of the admission shed lane). The
+    driver's connect loop absorbs this transparently."""
+
+    def __init__(self, retry_after: int):
+        super().__init__(
+            f"doc boot parked by cold-start admission; retry in "
+            f"{retry_after}ms")
+        self.retry_after_ms = retry_after
+
+
+#: Give a parked connect this long to win a boot slot before erroring
+#: out — covers a 10k-doc storm draining through a bounded executor.
+_BOOT_RETRY_MAX_S = 60.0
+
+
 class _Transport:
     """One framed TCP connection + reader thread + rid-matched requests."""
 
@@ -203,6 +221,8 @@ class _Transport:
             if reply.get("code") == "log_truncated":
                 raise LogTruncatedError(int(reply.get("base", 0)),
                                         snapshot_seq=reply.get("snapshotSeq"))
+            if reply.get("code") == "boot_pending":
+                raise BootPendingError(int(reply.get("retryAfterMs", 50)))
             raise RuntimeError(f"server error: {reply.get('message')}")
         return rid, reply
 
@@ -429,7 +449,21 @@ class NetworkDeltaConnection(DocumentDeltaConnection):
             # never enters the quorum — the session is free on the core's
             # op path (boots from snapshot cache + bounded backfill)
             connect_frame["readonly"] = 1
-        reply = transport.request(connect_frame)
+        # cold-start storm lane: a parked first-route (boot_pending)
+        # retries with the server's jittered backoff instead of failing
+        # the session — the connect-side twin of the shed-retry lane
+        deadline = time.monotonic() + _BOOT_RETRY_MAX_S
+        while True:
+            try:
+                reply = transport.request(connect_frame)
+                break
+            except BootPendingError as e:
+                delay = (e.retry_after_ms / 1000.0) \
+                    * (1.0 + 0.5 * random.random())
+                if time.monotonic() + delay >= deadline:
+                    raise
+                self.counters.inc("boot.parked.retries")
+                time.sleep(delay)
         self.client_id = reply["clientId"]
         self.initial_sequence_number = reply["seq"]
         self.mode = reply.get("mode", "write")

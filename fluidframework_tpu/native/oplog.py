@@ -61,6 +61,10 @@ class NativeOpLog:
         self._lib.oplog_seg_tear.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        self._lib.oplog_fd_cap.restype = ctypes.c_int
+        self._lib.oplog_fd_cap.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        self._lib.oplog_open_files.restype = ctypes.c_int64
+        self._lib.oplog_open_files.argtypes = [ctypes.c_void_p]
         self.readonly = readonly
         # topic-name encode cache: append/length/read run per record on
         # the durable hot path; str.encode is measurable there
@@ -113,6 +117,18 @@ class NativeOpLog:
         """Segment roll threshold for this handle (testing knob)."""
         if self._lib.oplog_seg_config(self._handle, seg_bytes) != 0:
             raise OSError("bad segment size")
+
+    def fd_cap(self, cap: int) -> None:
+        """Cap concurrently open FILE*s across this handle's topics and
+        segment streams (0 = unlimited). Topic metadata stays resident;
+        cold handles are flushed, closed, and reopened on demand — how a
+        core holds 10k+ rehydrated docs inside RLIMIT_NOFILE."""
+        if self._lib.oplog_fd_cap(self._handle, cap) != 0:
+            raise OSError("bad fd cap")
+
+    def open_files(self) -> int:
+        """Currently open FILE*s (tests and fd budgeting)."""
+        return int(self._lib.oplog_open_files(self._handle))
 
     def seg_append(self, stream: str, first_seq: int, last_seq: int,
                    block: bytes, btype: int) -> int:

@@ -82,6 +82,9 @@ KINDS = {
     "history.integrate": "fork tail integrated back into its parent",
     "history.ref.recover": "recovery adopted/discarded a pending fork",
     "history.gc": "chunk GC swept unreferenced snapshot chunks",
+    "core.cold_boot": "cold core armed lazy rehydration over its claims",
+    "part.rehydrated": "partition served its first lazy doc boot",
+    "part.checkpoint_fail": "one doc's checkpoint raised (others kept going)",
 }
 
 

@@ -67,6 +67,13 @@ _BIN_RAW_ABOX = 1   # legacy raw boxcar (JSON header + column bytes)
 _BIN_ABATCH = 2     # legacy sequenced batch (record-format deltas topics)
 _BIN_RAW_COLS = 3   # raw boxcar: route header + binwire cols section
 
+#: Native-handle budget per DurableLog handle (override per instance or
+#: with FLUID_LOG_FD_CAP). A sharded core owns several per-partition
+#: logs plus sockets, all inside one RLIMIT_NOFILE — at ~8 handles per
+#: resident doc an uncapped 10k-doc rehydration would exhaust any
+#: realistic limit, so cold handles LRU-cycle under this cap instead.
+LOG_FD_CAP = int(os.environ.get("FLUID_LOG_FD_CAP", "2048"))
+
 _RAW_COLS_HDR = struct.Struct("<d")  # boxcar timestamp
 
 
@@ -286,7 +293,8 @@ class DurableLog(OrderedLogBase):
 
     def __init__(self, directory: str, readonly: bool = False,
                  segmented: bool = True,
-                 segment_bytes: Optional[int] = None):
+                 segment_bytes: Optional[int] = None,
+                 fd_cap: Optional[int] = None):
         super().__init__()
         self.directory = directory
         self.readonly = readonly
@@ -294,6 +302,11 @@ class DurableLog(OrderedLogBase):
         self._segmented = segmented
         if segment_bytes is not None:
             self._log.seg_config(segment_bytes)
+        # ~8 native handles per resident doc would blow RLIMIT_NOFILE at
+        # fleet scale (a 10k-doc mass rehydration is the concrete case);
+        # the native layer LRU-cycles cold handles under this cap while
+        # topic metadata stays resident. 0 disables.
+        self._log.fd_cap(LOG_FD_CAP if fd_cap is None else fd_cap)
         self.counters = tier_counters("storage")
         # last-record decode cache per topic, PRIMED at append: the
         # drain delivers each record to every subscriber back to back
@@ -309,7 +322,15 @@ class DurableLog(OrderedLogBase):
         self._san_cache: dict[str, str] = {}
         self._seg_route: dict[str, Optional[str]] = {}
         self._seg_last: dict[str, int] = {}  # highest indexed seq span end
-        self._readers: dict[str, SegmentReader] = {}
+        # reader LRU: each SegmentReader pins 1 fd per mmap (CPython
+        # dups the fd behind mmap.mmap), so resident readers are fd
+        # budget exactly like native handles — cold ones close and
+        # rebuild on demand (refresh revalidates from the index, no
+        # record decodes)
+        from collections import OrderedDict
+        cap = LOG_FD_CAP if fd_cap is None else fd_cap
+        self._reader_cap = max(32, cap // 4) if cap else 0
+        self._readers: "OrderedDict[str, SegmentReader]" = OrderedDict()
         self._torn_count = 0
 
     # ------------------------------------------------------ topic routing
@@ -349,6 +370,11 @@ class DurableLog(OrderedLogBase):
             flush = None if self.readonly else self._log.flush
             r = self._readers[stream] = SegmentReader(
                 self.directory, stream, flush=flush)
+            while self._reader_cap and len(self._readers) > self._reader_cap:
+                _, cold = self._readers.popitem(last=False)
+                cold.close()
+        else:
+            self._readers.move_to_end(stream)
         return r
 
     # ---------------------------------------------------------- tailing
@@ -561,6 +587,18 @@ class DurableLog(OrderedLogBase):
         self._len_cache[topic] = offset + 1
         self._read_cache[topic] = (offset, value)
         return offset
+
+    def first_offset_covering(self, topic: str, seq: int) -> int:
+        """Lazy cold-boot tail entry: the lowest record offset whose
+        block may hold any seq' ≥ ``seq`` — one binary search over the
+        mmap'd seq-span index, zero record decodes. Record-lane topics
+        have no index and return 0 (the subscriber's skip absorbs the
+        prefix)."""
+        reader = self.segment_reader(topic)
+        if reader is None:
+            return 0
+        reader.refresh()
+        return reader.first_covering(seq)
 
     # ------------------------------------------------------ backfill door
 

@@ -71,6 +71,7 @@ from .admission import AdmissionController, retry_after_ms
 from .array_batch import ArrayBoxcar
 from .local_server import LocalServer, ServerConnection
 from .presence import PresenceLane
+from .rehydrate import BootPending
 from .scriptorium import LogTruncatedError
 
 MAX_FRAME = 8 * 1024 * 1024  # absolute wire-frame cap (storage payloads)
@@ -500,7 +501,7 @@ class _ClientSession:
                        "admin_tier_snapshot", "admin_rebalance_status",
                        "admin_placement_drain", "admin_migrate_part",
                        "admin_journal", "admin_metrics_history",
-                       "admin_flight_dump"):
+                       "admin_flight_dump", "admin_boot_status"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -522,6 +523,12 @@ class _ClientSession:
                     # the snapshot-backed base: an acked summary at this
                     # seq boots the client past the hole
                     err["snapshotSeq"] = e.snapshot_seq
+            elif isinstance(e, BootPending):
+                # cold-start storm: the rehydration executor parked this
+                # first-route — the driver retries after the hint
+                # instead of surfacing a failed session
+                err["code"] = "boot_pending"
+                err["retryAfterMs"] = e.retry_after_ms
             self.push("error", err)
 
     def handle_binary(self, body: bytes) -> None:
@@ -1371,6 +1378,32 @@ class _ClientSession:
                     frame.get("name")),
                 "now_mono": time.monotonic(),
                 "now_wall": time.time()})
+        elif t == "admin_boot_status":
+            # read-only: this core's cold-start rehydration progress —
+            # per-partition booted/pending docs, executor depth, and the
+            # process's boot.part.* counters (tier-summed: the orderers
+            # count on their own frontend-tier sheet, not front.counters)
+            from ..obs import tier_snapshot
+
+            boot_counts = {k: v
+                           for k, v in tier_snapshot("frontend").items()
+                           if k.startswith(("boot.part.", "topology."))}
+            sh = front.shard_host
+            if sh is not None:
+                parts = [s.boot_status()
+                         for _, s in sorted(sh.servers.items())]
+                rehydrator = sh.rehydrator
+                owner = sh.owner_id
+            else:
+                parts = [front.server.boot_status()]
+                rehydrator = front.server.rehydrator
+                owner = None
+            self.push("admin", {"rid": rid, "boot": {
+                "owner": owner,
+                "parts": parts,
+                "executor": (rehydrator.status()
+                             if rehydrator is not None else None),
+                "counters": boot_counts}})
         elif t == "admin_flight_dump":
             # operator door onto the flight recorder: dump the rings NOW
             # (incident in progress, evidence wanted before it scrolls
@@ -1516,6 +1549,13 @@ class ShardHost:
         # each poll — a draining host claims nothing (the rebalancer
         # evacuates what it still owns)
         self.draining = False
+        # fleet cold start (service/rehydrate.py): claiming a partition
+        # builds NO doc pipelines; first routes boot O(snapshot+tail).
+        # The rehydrator — when the front end arms one — bounds a boot
+        # storm by parking excess first-routes on the retry lane.
+        self.lazy_boot = True
+        self.rehydrator = None
+        self._cold_boot_noted = False
 
     def _make_server(self, k: int) -> LocalServer:
         import os
@@ -1544,6 +1584,16 @@ class ShardHost:
         # which partition this server sequences — the front end's heat
         # recording labels the windowed series with it
         server.part_k = k
+        server.lazy_boot = self.lazy_boot
+        server.rehydrator = self.rehydrator
+        if self.lazy_boot:
+            pending = server.scan_boot_pending()
+            if pending and not self._cold_boot_noted:
+                # cold start: docs exist on disk and none are booted —
+                # journal the recovery shape once per process
+                self._cold_boot_noted = True
+                self.journal.emit("core.cold_boot", owner=self.owner_id,
+                                  part=k, docs_pending=pending)
         return server
 
     def _reload_tenants(self) -> None:
@@ -1761,6 +1811,22 @@ class NetworkFrontEnd:
         adm = self.enable_admission()
         adm.engine = engine
         adm.shedding = shedding
+        return self
+
+    def enable_boot_admission(self, boots_per_s: float = 200.0,
+                              burst: int = 32) -> "NetworkFrontEnd":
+        """Arm boot-storm admission: one rehydration executor per core
+        shared by every partition server (current AND late-claimed —
+        ShardHost stamps it in _make_server)."""
+        from .rehydrate import RehydrationExecutor
+
+        ex = RehydrationExecutor(boots_per_s, burst)
+        if self.shard_host is not None:
+            self.shard_host.rehydrator = ex
+            for s in self.shard_host.servers.values():
+                s.rehydrator = ex
+        else:
+            self.server.rehydrator = ex
         return self
 
     def record_heat(self, server, n_ops: int, n_bytes: int) -> None:
@@ -2362,66 +2428,77 @@ def main() -> None:
                         default=0.25, metavar="F",
                         help="min hottest→coldest gap as a fraction of "
                              "mean load before a move is worth it")
+    # fleet topology spec (service/topology.py): the whole deployment
+    # as one JSON object; every sharded construction path converges on
+    # topology.build_core, so a restart from the spec IS the start
+    parser.add_argument("--topology", default=None, metavar="SPEC.json",
+                        help="start one core of a declarative fleet "
+                             "spec (supersedes the --shard-dir flag "
+                             "family)")
+    parser.add_argument("--core-index", type=int, default=0,
+                        metavar="I", help="which spec core this "
+                                          "process is")
+    parser.add_argument("--boot-rate", type=float, default=200.0,
+                        metavar="N",
+                        help="boot-storm admission: doc rehydrations "
+                             "per second this core will run; excess "
+                             "first-routes park on the retry lane "
+                             "(0 disarms)")
+    parser.add_argument("--boot-burst", type=int, default=32,
+                        metavar="N",
+                        help="boot-storm admission burst size")
     args = parser.parse_args()
-    if args.rebalance and args.shard_dir is None:
+    if args.rebalance and args.shard_dir is None and args.topology is None:
         parser.error("--rebalance requires --shard-dir")
-    if args.shard_dir is not None:
+    if args.topology is not None or args.shard_dir is not None:
         import gc as _gc
 
+        from .topology import CoreSpec, TopologySpec, build_core
+
         if args.consume_backchannel or args.external_scribe:
-            parser.error("--shard-dir does not compose with per-stage "
+            parser.error("sharded cores do not compose with per-stage "
                          "backchannels yet")
         if args.tenant or args.log_dir or args.storage_dir:
             # refuse loudly: silently dropping --tenant would start an
             # auth-less deployment the operator believes is secured
-            parser.error("--shard-dir does not compose with --tenant/"
+            parser.error("sharded cores do not compose with --tenant/"
                          "--log-dir/--storage-dir (per-partition logs "
                          "live under the shard dir; use "
                          "--storage-server for storage)")
-        storage_server = None
-        if args.storage_server:
-            host, _, sp = args.storage_server.rpartition(":")
-            storage_server = (host or "127.0.0.1", int(sp))
-        prefer = [int(k) for k in args.prefer.split(",") if k != ""]
-        shard_host = ShardHost(args.shard_dir, args.shards, prefer=prefer,
-                               storage_server=storage_server,
-                               ttl_s=args.lease_ttl)
-        # audit journal: one JSONL per core under the shard dir (admin
-        # journal --fleet merges them); the file is named by the core's
-        # STABLE role (its preferred partitions) so a restarted core
-        # reopens its own journal and continues the id space — that is
-        # what makes core.recover detectable. The epoch stamp reads the
-        # mtime-cached table, so each emit costs one stat.
-        import os as _os
-
-        from ..obs import arm_journal
-
-        core_name = ("core-" + "-".join(str(k) for k in prefer)
-                     if prefer else shard_host.owner_id)
-        table = shard_host.table
-        jr = arm_journal(
-            _os.path.join(args.shard_dir, "journal",
-                          f"{core_name}.jsonl"),
-            core=core_name,
-            epoch_fn=lambda: table.read().get("epoch", 0))
-        jr.emit("core.recover" if jr.seq else "core.start",
-                owner=shard_host.owner_id, shards=args.shards,
-                prefer=prefer)
+        if args.topology is not None:
+            spec = TopologySpec.load(args.topology)
+            core_index = args.core_index
+        else:
+            # the flag family is now sugar: one single-core spec, same
+            # construction path. The core's journal file is named by
+            # its STABLE role (preferred partitions) so a restarted
+            # core reopens its own journal and continues the id space
+            # — that is what makes core.recover detectable.
+            prefer = [int(k) for k in args.prefer.split(",") if k != ""]
+            name = ("core-" + "-".join(str(k) for k in prefer)
+                    if prefer else "")
+            spec = TopologySpec(
+                shard_dir=args.shard_dir, n_partitions=args.shards,
+                cores=[CoreSpec(name=name, prefer=prefer,
+                                port=args.port)],
+                host=args.host, lease_ttl=args.lease_ttl,
+                admin_secret=args.admin_secret,
+                summarize_every=args.summarize_every,
+                storage_server=args.storage_server,
+                boot_rate=args.boot_rate, boot_burst=args.boot_burst,
+                rebalance=({
+                    "tick_s": args.rebalance_tick,
+                    "dwell_s": args.rebalance_dwell,
+                    "budget": args.rebalance_budget,
+                    "improvement": args.rebalance_improvement,
+                } if args.rebalance else None))
+            core_index = 0
         _gc.freeze()
         _gc.disable()
-        front = NetworkFrontEnd(host=args.host, port=args.port,
-                                max_message_size=args.max_message_size,
-                                shard_host=shard_host,
-                                admin_secret=args.admin_secret)
+        front = build_core(spec, core_index)
+        if args.max_message_size is not None:
+            front.max_message_size = args.max_message_size
         _apply_overload_flags(front, args, parser)
-        if args.summarize_every is not None:
-            front.enable_summarizer(args.summarize_every)
-        if args.rebalance:
-            front.enable_rebalancer(
-                tick_s=args.rebalance_tick,
-                dwell_s=args.rebalance_dwell,
-                budget=args.rebalance_budget,
-                improvement=args.rebalance_improvement)
         front.serve_forever()
         return
     server = None

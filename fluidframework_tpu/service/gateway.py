@@ -62,6 +62,28 @@ from .front_end import (_BULK_FRAMES, _encode_frame, _frame_buffered,
                         _read_body)
 
 
+class _CoreError(RuntimeError):
+    """An upstream error reply, with its machine-readable fields kept —
+    a relayed ``boot_pending`` (cold-start admission parking) must reach
+    the leaf client's retry lane intact through any number of tiers."""
+
+    def __init__(self, reply: dict):
+        super().__init__(f"core error: {reply.get('message')}")
+        self.code = reply.get("code")
+        self.retry_after_ms = reply.get("retryAfterMs")
+
+
+def _error_frame(frame: dict, e: BaseException) -> dict:
+    err = {"t": "error", "rid": frame.get("rid"), "message": str(e)}
+    code = getattr(e, "code", None)
+    if code:
+        err["code"] = code
+        retry = getattr(e, "retry_after_ms", None)
+        if retry is not None:
+            err["retryAfterMs"] = retry
+    return err
+
+
 class _GatewaySession:
     """One client connection terminated at this gateway.
 
@@ -510,7 +532,7 @@ class Gateway:
             target.pending_rids.discard(rid)
             self._pending.pop(rid, None)
         if reply.get("t") == "error":
-            raise RuntimeError(f"core error: {reply.get('message')}")
+            raise _CoreError(reply)
         return reply
 
     async def _upstream_loop(self, reader: asyncio.StreamReader,
@@ -762,10 +784,7 @@ class Gateway:
                                 # a core error reply (auth refusal,
                                 # storage failure) answers THIS request
                                 # — it must not kill the socket
-                                session.push(
-                                    {"t": "error",
-                                     "rid": frame.get("rid"),
-                                     "message": str(e)})
+                                session.push(_error_frame(frame, e))
                     body = None
                     if n < 64 and _frame_buffered(reader):
                         body = await _read_body(reader)
@@ -773,9 +792,7 @@ class Gateway:
                     try:
                         await session.handle(frame)
                     except (RuntimeError, ConnectionError) as e:
-                        session.push({"t": "error",
-                                      "rid": frame.get("rid"),
-                                      "message": str(e)})
+                        session.push(_error_frame(frame, e))
                 await writer.drain()
         except (ValueError, json.JSONDecodeError):
             pass

@@ -110,6 +110,13 @@ class OrderedLogBase:
     def length(self, topic: str) -> int:
         return self._stored_length(topic)
 
+    def first_offset_covering(self, topic: str, seq: int) -> int:
+        """Lowest record offset that may hold sequence numbers ≥ ``seq``
+        — where a lazy cold boot tails in. Storage without a seq index
+        returns 0: the subscribers' own idempotent skip absorbs the
+        prefix (correct, just not lazy)."""
+        return 0
+
     def read(self, topic: str, offset: int) -> Any:
         return self._load(topic, offset)
 

@@ -22,6 +22,12 @@ from .scriptorium import ScriptoriumLambda
 
 CHECKPOINT_COLLECTION = "deli-checkpoints"
 
+#: Lazy cold boot keeps this many ops below the acked boot summary in
+#: the rebuilt scriptorium store when no retention margin is configured
+#: — the same in-flight-backfill safety window config.log_retention_ops
+#: defaults to.
+LAZY_BOOT_MARGIN = 1000
+
 
 def _versions_topic(tenant_id: str, document_id: str) -> str:
     return f"versions/{tenant_id}/{document_id}"
@@ -84,6 +90,7 @@ class LocalOrderer:
         log_retention_ops: Optional[int] = None,
         external_scribe: bool = False,
         on_version_persisted=None,
+        lazy_boot: bool = False,
     ):
         # fires once per newly-acked version, after the durable append —
         # the storage-process deployment advances the doc's named ref here
@@ -95,6 +102,9 @@ class LocalOrderer:
         self._pubsub = pubsub
         self.raw_topic = f"rawops/{tenant_id}/{document_id}"
         self.deltas_topic = f"deltas/{tenant_id}/{document_id}"
+        # set before the lambdas exist: boot replay routes through the
+        # same funnels (order/_on_sequenced) that mark the state dirty
+        self._dirty = False
 
         # restore deli from its checkpoint if present (restart path, ref:
         # deli/lambdaFactory.ts:54). Two sources: the db (in-proc restart)
@@ -162,14 +172,57 @@ class LocalOrderer:
         # Handler objects are kept for close(): bound-method attribute
         # access creates a fresh object each time, so unsubscribe needs the
         # exact references that were registered.
+        #
+        # LAZY COLD BOOT (fleet cold start): with ``lazy_boot`` and a
+        # usable checkpoint + acked summary, the replay is O(tail), not
+        # O(whole log). Deli and scribe resume one past their
+        # checkpointed offsets (their handlers would skip every earlier
+        # record anyway — subscribing past them skips the READS and
+        # decodes); scriptorium keeps only the tail a joiner cannot get
+        # from the latest acked summary, with the truncation declared
+        # BEFORE the replay so the append path drops boundary overlap.
+        raw_from = 0
+        scrip_from = 0
+        scribe_from = 0
+        self.boot_mode = None  # None (in-proc warm) | fresh|lazy|full_replay
+        if lazy_boot:
+            boot_seq = self.acked_boot_seq()
+            if log.length(self.raw_topic) <= 0:
+                self.boot_mode = "fresh"
+            elif checkpoint is not None and boot_seq is not None:
+                margin = (self._retention_margin
+                          if self._retention_margin is not None
+                          else LAZY_BOOT_MARGIN)
+                lazy_base = max(
+                    log_cp.get("scriptorium_base", 0) if log_cp else 0,
+                    boot_seq - margin, 0)
+                raw_from = checkpoint.log_offset + 1
+                if scribe_state is not None:
+                    scribe_from = int(scribe_state.get("offset", -1)) + 1
+                self.scriptorium.truncate_below(
+                    tenant_id, document_id, lazy_base)
+                scrip_from = log.first_offset_covering(
+                    self.deltas_topic, lazy_base + 1)
+                self.boot_mode = "lazy"
+            else:
+                # no checkpoint or no acked summary: a joiner would have
+                # nothing to boot from but the ops — replay it all
+                self.boot_mode = "full_replay"
+        from .rehydrate import boot_counters
+        if self.boot_mode == "lazy":
+            boot_counters().inc("boot.part.lazy")
+        elif self.boot_mode == "full_replay":
+            boot_counters().inc("boot.part.full_replay")
+        elif self.boot_mode == "fresh":
+            boot_counters().inc("boot.part.fresh")
         self._subscriptions = [
-            (self.raw_topic, self.deli.handler, 0),
-            (self.deltas_topic, self.scriptorium.handler, 0),
+            (self.raw_topic, self.deli.handler, raw_from),
+            (self.deltas_topic, self.scriptorium.handler, scrip_from),
             (self.deltas_topic, self.broadcaster.handler, log.length(self.deltas_topic)),
         ]
         if not external_scribe:
             self._subscriptions.insert(
-                2, (self.deltas_topic, self.scribe.handler, 0))
+                2, (self.deltas_topic, self.scribe.handler, scribe_from))
         for topic, handler, from_offset in self._subscriptions:
             self._log.subscribe(topic, handler, from_offset=from_offset)
         # re-apply the persisted retention AFTER the deltas-topic replay
@@ -181,12 +234,14 @@ class LocalOrderer:
     # the front end calls this (alfred's connection.order()); accepts a
     # single RawMessage or a RawBoxcar (one log record either way)
     def order(self, raw) -> None:
+        self._dirty = True
         self._log.append(self.raw_topic, raw)
 
     def persist_version_record(self, handle: str, version: dict) -> None:
         """Append an acked version record to the durable versions topic —
         the scribe-ref commit path (in-core scribe AND the external
         scribe's backchannel both land here)."""
+        self._dirty = True
         self._log.append(_versions_topic(self.tenant_id, self.document_id),
                          {"handle": handle, "version": dict(version)})
         if self._on_version_persisted is not None:
@@ -220,6 +275,7 @@ class LocalOrderer:
         boot_seq = self.acked_boot_seq()
         if boot_seq is None:
             return
+        self._dirty = True  # the retained base rides the next checkpoint
         self.scriptorium.truncate_below(
             self.tenant_id, self.document_id,
             min(capture_seq, boot_seq) - self._retention_margin)
@@ -233,6 +289,7 @@ class LocalOrderer:
         col = summary_versions_collection(self.tenant_id, self.document_id)
         existing = self._db.find_one(col, handle)
         already_acked = bool(existing and existing.get("acked"))
+        self._dirty = True
         self._db.upsert(col, handle, dict(version))
         self.scribe.last_summary_head = handle
         if not already_acked:
@@ -250,7 +307,17 @@ class LocalOrderer:
         log can recover it after full process death, to the log too. The
         scriptorium retention base rides along: without it a restart
         would rebuild the full delta store from the durable deltas topic
-        and silently undo the truncation."""
+        and silently undo the truncation.
+
+        Clean pipelines skip the write entirely: the 2s service ticker
+        checkpoints every RESIDENT doc, and after a mass cold boot
+        thousands of idle rehydrated pipelines would each pay a
+        serialize + append per pass, stalling the event loop for tens
+        of seconds. Dirty tracking makes the ticker O(touched docs),
+        not O(resident docs); re-writing state identical to the last
+        durable checkpoint is semantically a no-op anyway."""
+        if not self._dirty:
+            return
         deli_state = self.deli.checkpoint().to_dict()
         scribe_state = self.scribe.checkpoint_state()
         key = f"{self.tenant_id}/{self.document_id}"
@@ -262,8 +329,10 @@ class LocalOrderer:
              "scriptorium_base": self.scriptorium.retained_base(
                  self.tenant_id, self.document_id)},
         )
+        self._dirty = False
 
     def _on_sequenced(self, msg: SequencedDocumentMessage) -> None:
+        self._dirty = True
         self._log.append(
             self.deltas_topic,
             {
@@ -280,6 +349,7 @@ class LocalOrderer:
         the dict lane a list of SequencedDocumentMessage."""
         from .array_batch import SequencedArrayBatch
 
+        self._dirty = True
         if type(msgs) is SequencedArrayBatch:
             record = {
                 "tenant_id": self.tenant_id,

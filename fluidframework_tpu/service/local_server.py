@@ -247,6 +247,14 @@ class LocalServer:
         # rebalancer's windowed heat series with it, and None means
         # single-pipeline: no heat accounting, nowhere to rebalance
         self.part_k = None
+        # fleet cold start (service/rehydrate.py): lazy_boot makes every
+        # first-route pipeline build O(snapshot+tail); the rehydrator —
+        # when ShardHost arms one — parks excess first-routes during a
+        # boot storm instead of letting them monopolize the loop
+        self.lazy_boot = False
+        self.rehydrator = None
+        self._rehydrated_noted = False
+        self._boot_inventory: set[str] = set()
 
     @property
     def history(self):
@@ -321,6 +329,12 @@ class LocalServer:
                                            required_scope=SCOPE_READ)
             can_write = can_write and SCOPE_WRITE in claims.get(
                 "scopes", [])
+        if (self.rehydrator is not None
+                and f"{tenant_id}/{document_id}" not in self._orderers):
+            # boot-storm admission: a first-route to a cold doc takes a
+            # boot slot or parks (BootPending → retryable nack); routes
+            # to already-warm docs never touch the bucket
+            self.rehydrator.admit(tenant_id, document_id)
         orderer = self._get_orderer(tenant_id, document_id)
         client_id = f"client-{self._client_epoch}-{next(self._client_counter)}"
         conn = ServerConnection(self, tenant_id, document_id, client_id, details)
@@ -513,13 +527,43 @@ class LocalServer:
                 log_retention_ops=retention if retention >= 0 else None,
                 external_scribe=self.external_scribe,
                 on_version_persisted=on_persisted,
+                lazy_boot=self.lazy_boot,
                 **kw)
             # epoch fence: deli consults the server's CURRENT fence on
             # every record (closure, so arming after boot still applies)
             self._orderers[key].deli.epoch_fence = (
                 lambda: self.epoch_fence() if self.epoch_fence is not None
                 else None)
+            if (self._orderers[key].boot_mode == "lazy"
+                    and not self._rehydrated_noted):
+                self._rehydrated_noted = True
+                from ..obs.journal import get_journal
+
+                get_journal().emit("part.rehydrated", part=self.part_k,
+                                   doc=key)
         return self._orderers[key]
+
+    # ------------------------------------------------------- cold start
+
+    def scan_boot_pending(self) -> int:
+        """Cold-start inventory: docs present on this partition's log
+        with no live pipeline yet. Listing is one directory scan (no
+        record reads) — the lazy contract. Feeds ``admin placement
+        boot`` progress."""
+        topics = getattr(self.log, "list_topics", None)
+        if topics is None:
+            return 0
+        self._boot_inventory = {
+            t[len("rawops/"):] for t in topics("rawops/")}
+        return len(self._boot_inventory)
+
+    def boot_status(self) -> dict:
+        """Rehydration progress for the operator door."""
+        pending = sum(1 for k in self._boot_inventory
+                      if k not in self._orderers)
+        return {"part": self.part_k,
+                "docs_booted": len(self._orderers),
+                "docs_pending": pending}
 
     def _submit(self, conn: ServerConnection, messages: list[DocumentMessage]) -> None:
         self._check_revoked()

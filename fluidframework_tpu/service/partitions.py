@@ -62,21 +62,51 @@ class Partition:
         return o
 
     def checkpoint(self) -> None:
-        for o in self.orderers.values():
+        """Checkpoint every doc pipeline. One raising orderer must not
+        abort the rest — every doc that CAN shrink its replay window
+        does; each failure is journaled and the first re-raises at the
+        end so callers still see it."""
+        first_err = None
+        for key, o in self.orderers.items():
             if self.fault_plane is not None:
                 # kill between one doc's checkpoint and the next: the
                 # un-checkpointed docs recover by raw-log replay
                 self.fault_plane("partition.checkpoint", pid=self.pid)
-            o.checkpoint()
+            try:
+                o.checkpoint()
+            except Exception as e:  # noqa: BLE001 — isolate per doc
+                self._note_checkpoint_fail(key, e)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def _note_checkpoint_fail(self, key: str, err: Exception) -> None:
+        from ..obs.journal import get_journal
+
+        get_journal().emit("part.checkpoint_fail",
+                           cause=f"{type(err).__name__}: {err}",
+                           pid=self.pid, doc=key)
 
     def close(self, graceful: bool = True) -> None:
         """Graceful close checkpoints first (rebalance); a crash close
-        (graceful=False) just detaches — recovery is checkpoint+replay."""
-        for o in self.orderers.values():
+        (graceful=False) just detaches — recovery is checkpoint+replay.
+        A failing checkpoint never strands the remaining docs: every
+        orderer still checkpoints (best effort) AND closes, then the
+        first checkpoint error re-raises."""
+        first_err = None
+        for key, o in self.orderers.items():
             if graceful:
-                o.checkpoint()
+                try:
+                    o.checkpoint()
+                except Exception as e:  # noqa: BLE001 — isolate per doc
+                    self._note_checkpoint_fail(key, e)
+                    if first_err is None:
+                        first_err = e
             o.close()
         self.orderers.clear()
+        if first_err is not None:
+            raise first_err
 
 
 class PartitionHost:

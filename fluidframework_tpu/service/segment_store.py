@@ -153,6 +153,20 @@ class SegmentReader:
         mask = (last[lo:hi] > from_seq) & (first[lo:hi] < to_seq)
         return [lo + int(i) for i in np.nonzero(mask)[0]]
 
+    def first_covering(self, seq: int) -> int:
+        """Ordinal of the first block that may hold any seq' ≥ ``seq``
+        (0 when seq ≤ 1 or the stream is empty). Blocks below it have
+        running-max ``last`` < seq, so a tail subscription starting
+        here misses nothing — the lazy cold-boot replay entry point.
+        Duplicate blocks above it (crash-replay span regressions) are
+        redelivered and absorbed by the consumers' idempotent skip."""
+        n = self._n
+        if n == 0 or seq <= 1:
+            return 0
+        last = self._idx["last"][:n].astype(np.int64, copy=False)
+        return int(np.searchsorted(np.maximum.accumulate(last), seq - 1,
+                                   side="right"))
+
     def close(self) -> None:
         for mm in self._seg_mm.values():
             mm.close()

@@ -1,0 +1,519 @@
+"""Fleet topology spec: a whole deployment — and its restart — as ONE
+declarative object.
+
+Ref: Routerlicious ships as a helm chart — alfred/deli/scribe replica
+counts, kafka topics, and redis endpoints live in one values file, and
+"restart the cluster" means re-applying that file (SURVEY §5). Our
+deployment knowledge had instead spread across four construction paths
+that each re-derived it by hand: ``front_end`` main()'s flag soup,
+bench harnesses re-assembling argv per core, gateways wired by
+positional ports, and in-process tests building ShardHosts directly.
+A cold restart therefore had no single artifact to restart FROM — the
+operator (or bench) had to replay the original command lines from
+memory.
+
+:class:`TopologySpec` is that artifact: partitions, cores (with their
+preferred claims and ports), gateway relay tiers, the shard dir, and
+the boot-admission budget, JSON round-trippable via ``save``/``load``.
+Every construction path now converges on :func:`build_core` — the one
+function that turns (spec, core_index) into a serving
+``NetworkFrontEnd`` — so ``front_end --topology spec.json
+--core-index 2`` and an in-process test fleet are the same code.
+:class:`Fleet` drives the whole object: start every core (and gateway)
+from the spec, SIGKILL the lot mid-traffic, and restart from the same
+spec — the cold-start storm bench (``bench.py net_cold_storm``) and
+the cold-start chaos drill are its two callers.
+
+Counters (tier "frontend", locked in fluidlint's registry):
+
+    topology.fleet.starts     fleets started from a spec
+    topology.fleet.restarts   fleets RE-started from the same spec
+    topology.fleet.kills      whole-fleet kill -9s issued
+    topology.core.spawns      cores constructed via build_core
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class CoreSpec:
+    """One ordering core: which partitions it prefers to claim and
+    where it listens. ``port=0`` lets the OS pick (the Fleet records
+    the bound port from the core's LISTENING line / front object)."""
+
+    name: str
+    prefer: list = dataclasses.field(default_factory=list)
+    port: int = 0
+
+
+@dataclasses.dataclass
+class GatewaySpec:
+    """One gateway tier node. ``upstream`` chains relay tiers: None
+    routes shard-aware against the epoch table (leaf-of-cores tier),
+    an int splices through that gateway index (deeper fan-out tiers
+    speak the muxed link protocol upward)."""
+
+    name: str
+    port: int = 0
+    upstream: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TopologySpec:
+    """The whole fleet as data. See the module docstring."""
+
+    shard_dir: str
+    n_partitions: int
+    cores: list = dataclasses.field(default_factory=list)
+    gateways: list = dataclasses.field(default_factory=list)
+    host: str = "127.0.0.1"
+    lease_ttl: Optional[float] = None
+    admin_secret: Optional[str] = None
+    summarize_every: Optional[int] = None
+    storage_server: Optional[str] = None  # "host:port" or "port"
+    # when set, the Fleet RUNS a storage server over this dir and wires
+    # every core to it — summaries must outlive core processes or a
+    # cold restart has no snapshot to lazy-boot from
+    storage_dir: Optional[str] = None
+    # boot-storm admission (service/rehydrate.py): each core's
+    # rehydration executor budget; rate <= 0 disarms (unbounded boots)
+    boot_rate: float = 200.0
+    boot_burst: int = 32
+    # self-driving placement: kwargs for enable_rebalancer, or None
+    rebalance: Optional[dict] = None
+
+    # ---- JSON round-trip ------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["cores"] = [dataclasses.asdict(c) if not isinstance(c, dict)
+                      else c for c in self.cores]
+        d["gateways"] = [dataclasses.asdict(g) if not isinstance(g, dict)
+                         else g for g in self.gateways]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TopologySpec":
+        d = dict(d)
+        d["cores"] = [CoreSpec(**c) for c in d.get("cores", [])]
+        d["gateways"] = [GatewaySpec(**g) for g in d.get("gateways", [])]
+        return cls(**d)
+
+    def save(self, path: str) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "TopologySpec":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    # ---- derived views --------------------------------------------
+
+    def storage_addr(self) -> Optional[tuple]:
+        if not self.storage_server:
+            return None
+        host, _, port = self.storage_server.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
+    def core_name(self, i: int) -> str:
+        return self.cores[i].name or f"core{i}"
+
+    def spec_path(self) -> str:
+        """Canonical on-disk home: the spec lives beside the state it
+        describes, so a restart needs only the shard dir."""
+        return os.path.join(self.shard_dir, "topology.json")
+
+    def core_argv(self, i: int, spec_path: str,
+                  python: str = sys.executable) -> list:
+        return [python, "-m", "fluidframework_tpu.service.front_end",
+                "--topology", spec_path, "--core-index", str(i)]
+
+    def gateway_argv(self, i: int, core_ports: dict,
+                     gateway_ports: dict,
+                     python: str = sys.executable) -> list:
+        g = self.gateways[i]
+        argv = [python, "-m", "fluidframework_tpu.service.gateway",
+                "--host", self.host, "--port", str(g.port)]
+        if g.upstream is not None:
+            up = gateway_ports[g.upstream]
+            argv += ["--upstream-gateway", f"{self.host}:{up}"]
+        else:
+            argv += ["--shard-dir", self.shard_dir,
+                     "--shards", str(self.n_partitions)]
+        return argv
+
+
+def build_core(spec: TopologySpec, core_index: int, *,
+               port: Optional[int] = None, arm_journal: bool = True):
+    """THE core construction path: (spec, index) → an un-started
+    ``NetworkFrontEnd``. ``front_end --topology`` (subprocess mode)
+    and :class:`Fleet` in-process mode both land here, so a restarted
+    fleet is byte-for-byte the construction the first start ran.
+
+    ``arm_journal=False`` skips arming the process-singleton audit
+    journal — required in-process, where many cores share one process
+    and tests inject private Journal instances instead.
+    """
+    from ..obs import get_journal
+    from .front_end import NetworkFrontEnd, ShardHost
+    from .rehydrate import boot_counters
+
+    core = spec.cores[core_index]
+    host = ShardHost(spec.shard_dir, spec.n_partitions,
+                     prefer=core.prefer,
+                     storage_server=spec.storage_addr(),
+                     ttl_s=spec.lease_ttl)
+    if arm_journal:
+        from ..obs import arm_journal as _arm
+
+        # journal file named by the core's STABLE role so a restarted
+        # core continues its id space; anonymous cores fall back to
+        # their (fresh) owner id — unique but not restart-stable
+        name = spec.cores[core_index].name or host.owner_id
+        table = host.table
+        jr = _arm(os.path.join(spec.shard_dir, "journal",
+                               f"{name}.jsonl"),
+                  core=name,
+                  epoch_fn=lambda: table.read().get("epoch", 0))
+    else:
+        jr = get_journal()
+    jr.emit("core.recover" if jr.seq else "core.start",
+            owner=host.owner_id, shards=spec.n_partitions,
+            prefer=list(core.prefer))
+    front = NetworkFrontEnd(
+        host=spec.host,
+        port=core.port if port is None else port,
+        shard_host=host, admin_secret=spec.admin_secret)
+    if spec.summarize_every is not None:
+        front.enable_summarizer(spec.summarize_every)
+    if spec.rebalance is not None:
+        front.enable_rebalancer(**spec.rebalance)
+    if spec.boot_rate and spec.boot_rate > 0:
+        front.enable_boot_admission(spec.boot_rate, spec.boot_burst)
+    boot_counters().inc("topology.core.spawns")
+    return front
+
+
+def default_spec(shard_dir: str, n_cores: int, n_partitions: int,
+                 **kw) -> TopologySpec:
+    """The common shape: partitions dealt round-robin across cores,
+    OS-assigned ports, no gateways, a fleet-run storage tier under the
+    shard dir (durable summaries are what make a cold boot
+    O(snapshot+tail) instead of O(log))."""
+    cores = [CoreSpec(name=f"core{i}",
+                      prefer=[k for k in range(n_partitions)
+                              if k % n_cores == i])
+             for i in range(n_cores)]
+    kw.setdefault("storage_dir", os.path.join(shard_dir, "storage"))
+    return TopologySpec(shard_dir=shard_dir, n_partitions=n_partitions,
+                        cores=cores, **kw)
+
+
+class Fleet:
+    """Drive a TopologySpec: start, kill -9, restart — the whole fleet
+    as one object.
+
+    Two modes share the spec and the construction path:
+
+    * ``subprocess=True`` — each core is ``front_end --topology
+      spec.json --core-index i`` (plus gateway processes); ``kill()``
+      is a real SIGKILL. The storm bench and chaos drill mode.
+    * in-process (default) — each core is ``build_core(...).
+      start_background()`` on its own loop thread; ``kill()`` abandons
+      the fronts without checkpoint or close (stop() tears down the
+      loop and sockets but never flushes pipeline state — the same
+      on-disk picture a SIGKILL leaves). The net_smoke gate and unit
+      tests run this mode.
+
+    ``restart()`` = ``kill()`` scars healed only by the recovery path:
+    a fresh Fleet state is rebuilt from the SAME spec, so anything the
+    spec fails to capture shows up as a restart that comes up wrong.
+    """
+
+    def __init__(self, spec: TopologySpec, subprocess: bool = False,
+                 env: Optional[dict] = None):
+        self.spec = spec
+        self.subprocess = subprocess
+        self.env = env
+        self.procs: dict[int, "subprocess.Popen"] = {}
+        self.gw_procs: dict[int, "subprocess.Popen"] = {}
+        self.fronts: dict[int, object] = {}  # in-proc NetworkFrontEnds
+        self.core_ports: dict[int, int] = {}
+        self.gw_ports: dict[int, int] = {}
+        self.storage_proc = None   # subprocess mode
+        self.storage_runner = None  # in-proc mode
+        self._generation = 0
+
+    # ---- lifecycle ------------------------------------------------
+
+    def start(self) -> "Fleet":
+        from .rehydrate import boot_counters
+
+        os.makedirs(self.spec.shard_dir, exist_ok=True)
+        # epoch floor: claims recorded AFTER this instant bump past it,
+        # which is how wait_claimed tells this generation's ownership
+        # from a dead generation's leftover rows (same addrs when the
+        # spec pins ports)
+        from .placement_plane import EpochTable
+
+        self._epoch_floor = EpochTable.for_shard_dir(
+            self.spec.shard_dir).read().get("epoch", 0)
+        counters = boot_counters()
+        if self._generation == 0:
+            counters.inc("topology.fleet.starts")
+        else:
+            counters.inc("topology.fleet.restarts")
+        self._generation += 1
+        if self.subprocess:
+            self._start_subprocess()
+        else:
+            self._start_inproc()
+        return self
+
+    def _start_inproc(self) -> None:
+        if self.spec.storage_dir:
+            self.storage_runner = _StorageRunner(self.spec.storage_dir,
+                                                 self.spec.host)
+            port = self.storage_runner.start()
+            self.spec.storage_server = f"{self.spec.host}:{port}"
+        for i in range(len(self.spec.cores)):
+            front = build_core(self.spec, i, arm_journal=False)
+            front.start_background()
+            self.fronts[i] = front
+            self.core_ports[i] = front.port
+        # in-process mode serves cores directly; gateway tiers are a
+        # subprocess-mode concern (their loops want own processes)
+
+    def _start_subprocess(self) -> None:
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        if self.spec.storage_dir:
+            self.storage_proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "fluidframework_tpu.service.storage_server",
+                 "--dir", self.spec.storage_dir,
+                 "--host", self.spec.host],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+            port = _read_listening(self.storage_proc, "storage")
+            self.spec.storage_server = f"{self.spec.host}:{port}"
+        # saved AFTER the storage tier binds: the spec file each core
+        # loads carries the resolved storage address
+        spec_path = self.spec.save(self.spec.spec_path())
+        for i in range(len(self.spec.cores)):
+            argv = self.spec.core_argv(i, spec_path)
+            self.procs[i] = subprocess.Popen(
+                argv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env)
+        for i, p in self.procs.items():
+            self.core_ports[i] = _read_listening(p, self.spec.core_name(i))
+        # gateways after cores: a shard-aware gateway routes from the
+        # epoch table the cores have begun writing; relay tiers after
+        # their upstream so the splice target exists
+        order = [i for i, g in enumerate(self.spec.gateways)
+                 if g.upstream is None]
+        order += [i for i, g in enumerate(self.spec.gateways)
+                  if g.upstream is not None]
+        for i in order:
+            argv = self.spec.gateway_argv(i, self.core_ports,
+                                          self.gw_ports)
+            self.gw_procs[i] = subprocess.Popen(
+                argv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env)
+            self.gw_ports[i] = _read_listening(
+                self.gw_procs[i], self.spec.gateways[i].name)
+
+    def kill(self) -> "Fleet":
+        """kill -9 the whole fleet: no checkpoint, no close, no
+        goodbye — the cold-start bench's opening move."""
+        from .rehydrate import boot_counters
+
+        boot_counters().inc("topology.fleet.kills")
+        victims = (list(self.gw_procs.values())
+                   + list(self.procs.values()))
+        if self.storage_proc is not None:
+            victims.append(self.storage_proc)
+        for p in victims:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+        for p in victims:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for front in self.fronts.values():
+            # abandon: stop() kills the loop + sockets but flushes
+            # NOTHING (no checkpoint_all, no orderer close) — the
+            # on-disk state is what the last 2 s ticker left, exactly
+            # a SIGKILL's aftermath
+            front.stop()
+        if self.storage_runner is not None:
+            self.storage_runner.stop()
+        self.procs.clear()
+        self.gw_procs.clear()
+        self.fronts.clear()
+        self.core_ports.clear()
+        self.gw_ports.clear()
+        self.storage_proc = None
+        self.storage_runner = None
+        return self
+
+    def restart(self) -> "Fleet":
+        """Restart from the spec — the artifact IS the runbook."""
+        if self.procs or self.fronts:
+            self.kill()
+        return self.start()
+
+    def checkpoint_all(self) -> None:
+        """Checkpoint + flush every in-proc core. The 2s checkpoint
+        ticker lives in serve_forever (subprocess cores get it for
+        free); an in-proc fleet must ask explicitly before a kill is
+        expected to be recoverable from the checkpoint."""
+        for front in self.fronts.values():
+            for server in front._all_servers():
+                server.checkpoint_all()
+            front._flush_logs()
+
+    def stop(self) -> None:
+        """Graceful-ish teardown for harness cleanup (not part of the
+        crash story): terminate subprocesses, stop in-proc loops."""
+        victims = (list(self.gw_procs.values())
+                   + list(self.procs.values()))
+        if self.storage_proc is not None:
+            victims.append(self.storage_proc)
+        for p in victims:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in victims:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        for front in self.fronts.values():
+            front.stop()
+        if self.storage_runner is not None:
+            self.storage_runner.stop()
+        self.procs.clear()
+        self.gw_procs.clear()
+        self.fronts.clear()
+        self.storage_proc = None
+        self.storage_runner = None
+
+    # ---- addressing -----------------------------------------------
+
+    def core_addr(self, i: int) -> tuple:
+        return (self.spec.host, self.core_ports[i])
+
+    def client_addr(self) -> tuple:
+        """Where clients dial: the deepest gateway tier if one exists,
+        else the first core."""
+        if self.gw_ports:
+            leaf = max(self.gw_ports)
+            return (self.spec.host, self.gw_ports[leaf])
+        return self.core_addr(0)
+
+    def wait_claimed(self, timeout: float = 30.0) -> None:
+        """Block until every partition is routed to one of THIS
+        generation's cores in the epoch table — 'the fleet is up'.
+        (After a restart the table still carries the dead generation's
+        rows, so mere presence of an owner proves nothing.)"""
+        from .placement_plane import EpochTable
+
+        table = EpochTable.for_shard_dir(self.spec.shard_dir)
+        want = {f"{self.spec.host}:{p}" for p in self.core_ports.values()}
+        floor = getattr(self, "_epoch_floor", 0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            parts = table.read().get("parts", {})
+            if (len(parts) >= self.spec.n_partitions
+                    and all(p.get("addr") in want
+                            and p.get("epoch", 0) > floor
+                            for p in parts.values())):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"fleet: partitions unclaimed after {timeout}s")
+
+
+class _StorageRunner:
+    """In-process storage tier: StorageServer on its own loop thread
+    (it has no background mode of its own — subprocess deployments run
+    it as a process)."""
+
+    def __init__(self, directory: str, host: str):
+        from .storage_server import StorageServer
+
+        self.srv = StorageServer(directory, host=host, port=0)
+        self.loop = None
+        self.thread = None
+
+    def start(self) -> int:
+        import asyncio
+        import threading
+
+        ready = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            self.loop = loop
+            asyncio.set_event_loop(loop)
+
+            async def bind():
+                s = await asyncio.start_server(
+                    self.srv._handle_conn, self.srv.host, self.srv.port,
+                    backlog=256)
+                self.srv.port = s.sockets[0].getsockname()[1]
+
+            loop.run_until_complete(bind())
+            ready.set()
+            loop.run_forever()
+
+        self.thread = threading.Thread(target=run, daemon=True,
+                                       name="fluid-storage")
+        self.thread.start()
+        ready.wait(timeout=10)
+        return self.srv.port
+
+    def stop(self) -> None:
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            if self.thread is not None:
+                self.thread.join(timeout=5)
+            self.loop = None
+
+
+def _read_listening(proc, name: str, timeout: float = 60.0) -> int:
+    """Parse the LISTENING readiness line a core/gateway prints; fail
+    loudly with the process's output if it died instead."""
+    deadline = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        lines.append(line.rstrip())
+        if line.startswith("LISTENING"):
+            return int(line.rsplit(":", 1)[1])
+    tail = "\n".join(lines[-20:])
+    raise RuntimeError(f"{name} never reported LISTENING "
+                       f"(rc={proc.poll()}):\n{tail}")

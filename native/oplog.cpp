@@ -10,6 +10,7 @@
 //
 // C ABI (ctypes-friendly), no exceptions across the boundary.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cctype>
@@ -23,6 +24,7 @@
 #else
 #include <unistd.h>
 #endif
+#include <utility>
 #include <vector>
 
 namespace {
@@ -44,6 +46,8 @@ struct Topic {
     std::vector<uint64_t> offsets;  // byte offset of each record
     uint64_t data_end = 0;
     bool dirty = false;  // appended-to since the last flush/sync
+    bool unsynced = false;  // appended-to since the last fsync
+    uint64_t last_use = 0;  // handle-LRU stamp
 };
 
 // --------------------------------------------------------------- segments
@@ -80,7 +84,9 @@ struct SegStream {
     uint64_t cur_off = 0;       // validated byte extent of the tail segment
     std::vector<SegEntry> entries;
     bool dirty = false;
+    bool unsynced = false;      // appended-to since the last fsync
     bool torn = false;          // deliberate torn bytes past cur_off on disk
+    uint64_t last_use = 0;      // handle-LRU stamp
 };
 
 struct OpLog {
@@ -93,7 +99,92 @@ struct OpLog {
     // writer's job — a reader truncating a live writer's ragged tail
     // would silently shift the writer's record ordinals)
     bool readonly = false;
+    // ------------------------------------------------------ handle LRU
+    // Topic/stream METADATA (offsets, seg entries, extents) stays
+    // resident forever — it is what makes length/read O(1) — but the
+    // FILE*s behind it are a bounded cache: a core holding 10k
+    // rehydrated docs at ~8 handles each would blow any RLIMIT_NOFILE.
+    // When open_files exceeds fd_cap (0 = unlimited), the
+    // least-recently-used quarter is flushed and closed; a later touch
+    // reopens on demand and trusts the in-memory metadata (single
+    // writer — no re-scan, no truncation).
+    uint64_t fd_cap = 0;
+    uint64_t open_files = 0;
+    uint64_t lru_clock = 0;
+    // files with appends not yet fsync'd whose handles were evicted:
+    // oplog_sync must cover them or the checkpoint-boundary durability
+    // contract silently narrows to "whatever happened to still be open"
+    std::vector<std::string> evicted_unsynced;
 };
+
+void evict_excess(OpLog* log) {
+    if (log->fd_cap == 0 || log->open_files <= log->fd_cap) return;
+    std::vector<std::pair<uint64_t, std::pair<bool, const std::string*>>> open_entries;
+    for (auto& kv : log->topics)
+        if (kv.second.data)
+            open_entries.push_back({kv.second.last_use, {false, &kv.first}});
+    for (auto& kv : log->segs)
+        // a torn stream's on-disk residue is deliberate state the next
+        // append must find exactly as left — never cycle its handles
+        if ((kv.second.index || kv.second.data) && !kv.second.torn)
+            open_entries.push_back({kv.second.last_use, {true, &kv.first}});
+    std::sort(open_entries.begin(), open_entries.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    // close down to 3/4 of the cap so evictions amortize over many opens
+    uint64_t target = log->fd_cap - log->fd_cap / 4;
+    for (const auto& ent : open_entries) {
+        if (log->open_files <= target) break;
+        if (ent.first == log->lru_clock) continue;  // the entry in use now
+        if (ent.second.first) {
+            SegStream& s = log->segs[*ent.second.second];
+            if (s.data) fflush(s.data);
+            if (s.index) fflush(s.index);
+            if (s.unsynced) {
+                log->evicted_unsynced.push_back(
+                    log->dir + "/" + *ent.second.second + ".segidx");
+                log->evicted_unsynced.push_back(
+                    log->dir + "/" + *ent.second.second + ".seg" +
+                    std::to_string(s.cur_seg));
+                s.unsynced = false;
+            }
+            if (s.data) { fclose(s.data); s.data = nullptr; log->open_files--; }
+            if (s.index) { fclose(s.index); s.index = nullptr; log->open_files--; }
+            s.dirty = false;
+        } else {
+            Topic& t = log->topics[*ent.second.second];
+            fflush(t.data);
+            fflush(t.index);
+            if (t.unsynced) {
+                log->evicted_unsynced.push_back(
+                    log->dir + "/" + *ent.second.second + ".data");
+                log->evicted_unsynced.push_back(
+                    log->dir + "/" + *ent.second.second + ".idx");
+                t.unsynced = false;
+            }
+            fclose(t.data);
+            fclose(t.index);
+            t.data = t.index = nullptr;
+            t.dirty = false;
+            log->open_files -= 2;
+        }
+    }
+}
+
+// reopen an evicted topic's handles, trusting the resident metadata
+bool reopen_topic(OpLog* log, const std::string& name, Topic* t) {
+    std::string base = log->dir + "/" + name;
+    const char* mode = log->readonly ? "rb" : "ab+";
+    t->data = fopen((base + ".data").c_str(), mode);
+    t->index = fopen((base + ".idx").c_str(), mode);
+    if (!t->data || !t->index) {
+        if (t->data) fclose(t->data);
+        if (t->index) fclose(t->index);
+        t->data = t->index = nullptr;
+        return false;
+    }
+    log->open_files += 2;
+    return true;
+}
 
 bool valid_topic_name(const char* t) {
     for (const char* p = t; *p; ++p) {
@@ -104,7 +195,15 @@ bool valid_topic_name(const char* t) {
 
 Topic* get_topic(OpLog* log, const char* name) {
     auto it = log->topics.find(name);
-    if (it != log->topics.end()) return &it->second;
+    if (it != log->topics.end()) {
+        Topic* t = &it->second;
+        t->last_use = ++log->lru_clock;
+        if (!t->data) {  // evicted: reopen on demand
+            if (!reopen_topic(log, it->first, t)) return nullptr;
+            evict_excess(log);
+        }
+        return t;
+    }
     if (!valid_topic_name(name)) return nullptr;
 
     Topic t;
@@ -180,7 +279,10 @@ Topic* get_topic(OpLog* log, const char* name) {
             t.data_end = valid_end;
         }
     }
+    t.last_use = ++log->lru_clock;
     auto res = log->topics.emplace(name, std::move(t));
+    log->open_files += 2;
+    evict_excess(log);
     return &res.first->second;
 }
 
@@ -198,9 +300,38 @@ uint64_t seg_file_size(OpLog* log, const char* name, uint32_t seg) {
     return n;
 }
 
+// reopen an evicted stream's handles, trusting the resident metadata
+// (the eviction flushed, so the tail segment's extent is authoritative)
+bool reopen_seg(OpLog* log, const std::string& name, SegStream* s) {
+    std::string ipath = log->dir + "/" + name + ".segidx";
+    s->index = fopen(ipath.c_str(), log->readonly ? "rb" : "ab+");
+    if (!s->index) return false;
+    log->open_files += 1;
+    if (!log->readonly) {
+        s->data = fopen(seg_path(log, name.c_str(), s->cur_seg).c_str(),
+                        "ab+");
+        if (!s->data) {
+            fclose(s->index);
+            s->index = nullptr;
+            log->open_files -= 1;
+            return false;
+        }
+        log->open_files += 1;
+    }
+    return true;
+}
+
 SegStream* get_seg(OpLog* log, const char* name) {
     auto it = log->segs.find(name);
-    if (it != log->segs.end()) return &it->second;
+    if (it != log->segs.end()) {
+        SegStream* s = &it->second;
+        s->last_use = ++log->lru_clock;
+        if (!s->index) {  // evicted: reopen on demand
+            if (!reopen_seg(log, it->first, s)) return nullptr;
+            evict_excess(log);
+        }
+        return s;
+    }
     if (!valid_topic_name(name)) return nullptr;
 
     SegStream s;
@@ -249,7 +380,10 @@ SegStream* get_seg(OpLog* log, const char* name) {
             return nullptr;
         }
     }
+    s.last_use = ++log->lru_clock;
     auto res = log->segs.emplace(name, std::move(s));
+    log->open_files += res.first->second.data ? 2 : 1;
+    evict_excess(log);
     return &res.first->second;
 }
 
@@ -332,7 +466,10 @@ int64_t oplog_seg_append(void* handle, const char* stream, int64_t first,
         s->cur_seg += 1;
         s->cur_off = 0;
         s->data = fopen(seg_path(log, stream, s->cur_seg).c_str(), "wb+");
-        if (!s->data) return -1;
+        if (!s->data) {
+            log->open_files -= 1;  // the closed tail; index stays open
+            return -1;
+        }
     }
     fseek(s->data, 0, SEEK_END);
     if (fwrite(data, 1, (size_t)len, s->data) != (size_t)len) {
@@ -356,6 +493,7 @@ int64_t oplog_seg_append(void* handle, const char* stream, int64_t first,
     s->entries.push_back(e);
     s->cur_off += (uint64_t)len;
     s->dirty = true;
+    s->unsynced = true;
     return (int64_t)s->entries.size() - 1;
 }
 
@@ -462,7 +600,10 @@ int oplog_seg_tear(void* handle, const char* stream, int64_t first,
         s->cur_seg += 1;
         s->cur_off = 0;
         s->data = fopen(seg_path(log, stream, s->cur_seg).c_str(), "wb+");
-        if (!s->data) return -1;
+        if (!s->data) {
+            log->open_files -= 1;  // the closed tail; index stays open
+            return -1;
+        }
     }
     size_t nbytes = mode == 0 ? (size_t)(len / 2 ? len / 2 : 1) : (size_t)len;
     fseek(s->data, 0, SEEK_END);
@@ -514,6 +655,7 @@ int64_t oplog_append(void* handle, const char* topic, const void* data,
     t->data_end = record_start + sizeof(len32) + (uint64_t)len;
     t->offsets.push_back(record_start);
     t->dirty = true;
+    t->unsynced = true;
     return (int64_t)t->offsets.size() - 1;
 }
 
@@ -555,16 +697,16 @@ int oplog_flush(void* handle) {
     if (!log) return -1;
     std::lock_guard<std::mutex> lk(log->mu);
     for (auto& kv : log->topics) {
-        if (!kv.second.dirty) continue;  // O(appended), not O(topics)
+        if (!kv.second.dirty || !kv.second.data) continue;  // O(appended)
         fflush(kv.second.data);
         fflush(kv.second.index);
         kv.second.dirty = false;
     }
     for (auto& kv : log->segs) {
-        if (!kv.second.dirty) continue;
+        if (!kv.second.dirty || !kv.second.index) continue;
         // block bytes before index entry: a reader that sees the entry
         // must find the bytes (mmap validation re-checks anyway)
-        fflush(kv.second.data);
+        if (kv.second.data) fflush(kv.second.data);
         fflush(kv.second.index);
         kv.second.dirty = false;
     }
@@ -613,22 +755,58 @@ int oplog_sync(void* handle) {
     if (!log) return -1;
     std::lock_guard<std::mutex> lk(log->mu);
     for (auto& kv : log->topics) {
+        if (!kv.second.data) continue;  // evicted: covered below
         fflush(kv.second.data);
         fflush(kv.second.index);
 #ifndef _WIN32
         fsync(fileno(kv.second.data));
         fsync(fileno(kv.second.index));
 #endif
+        kv.second.unsynced = false;
     }
     for (auto& kv : log->segs) {
+        if (!kv.second.index) continue;  // evicted: covered below
         if (kv.second.data) fflush(kv.second.data);
         fflush(kv.second.index);
 #ifndef _WIN32
         if (kv.second.data) fsync(fileno(kv.second.data));
         fsync(fileno(kv.second.index));
 #endif
+        kv.second.unsynced = false;
     }
+    // files whose handles were LRU-evicted after un-fsync'd appends:
+    // already in the page cache (eviction flushed), so a brief
+    // open+fsync+close keeps the durability contract whole
+    for (const std::string& path : log->evicted_unsynced) {
+        FILE* f = fopen(path.c_str(), "rb");
+        if (!f) continue;  // e.g. a rolled-away tail segment
+#ifndef _WIN32
+        fsync(fileno(f));
+#endif
+        fclose(f);
+    }
+    log->evicted_unsynced.clear();
     return 0;
+}
+
+// Cap on concurrently open FILE*s across this handle's topics and
+// segment streams (0 = unlimited). Metadata stays resident; cold
+// handles are flushed, closed, and reopened on demand.
+int oplog_fd_cap(void* handle, int64_t cap) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log || cap < 0) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    log->fd_cap = (uint64_t)cap;
+    evict_excess(log);
+    return 0;
+}
+
+// Currently open FILE*s (introspection for tests and fd budgeting).
+int64_t oplog_open_files(void* handle) {
+    auto* log = static_cast<OpLog*>(handle);
+    if (!log) return -1;
+    std::lock_guard<std::mutex> lk(log->mu);
+    return (int64_t)log->open_files;
 }
 
 }  // extern "C"

@@ -1,0 +1,346 @@
+"""Fleet cold start: lazy O(snapshot+tail) rehydration, boot-storm
+admission, and the topology spec that makes a restart one object.
+
+The contract under test (service/rehydrate.py + local_orderer.py):
+
+* A core restart builds NO doc pipelines at claim time; a doc's first
+  route boots it from the latest acked summary + the durable-log tail
+  (deli from its checkpoint offset, scriptorium from the first block
+  covering the retention base, scribe from its own durable offset) —
+  and the rehydrated doc is byte-identical to a whole-log replay.
+* ``boot.part.lazy`` / ``boot.part.full_replay`` counters prove which
+  path ran: a checkpointed + summarized doc must NEVER whole-log
+  replay.
+* The rehydration executor parks first-routes beyond its token budget
+  on the shed-retry lane (``BootPending`` → driver retry), then serves
+  them — warm docs never queue behind a boot storm.
+* ``TopologySpec`` round-trips through JSON, and a Fleet started from
+  it claims exactly the partitions the spec declares.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import time
+
+import pytest
+
+from fluidframework_tpu.driver.local import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service.durable_log import DurableLog
+from fluidframework_tpu.service.local_server import LocalServer
+from fluidframework_tpu.service.rehydrate import (
+    BootPending,
+    RehydrationExecutor,
+    boot_counters,
+)
+from fluidframework_tpu.service.service_summarizer import (
+    HostReplicaSource,
+    ServiceSummarizer,
+)
+
+
+def wait_for(cond, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _summarize(server, tenant, doc):
+    svc = ServiceSummarizer(server, HostReplicaSource(server))
+    version = svc.summarize_doc(tenant, doc)
+    assert version is not None
+    return version
+
+
+def _seeded_edits(s, rng, n):
+    for i in range(n):
+        text = s.get_text()
+        if text and rng.random() < 0.3:
+            at = rng.randrange(len(text))
+            s.remove_text(at, min(len(text), at + rng.randint(1, 4)))
+        else:
+            at = rng.randrange(len(text) + 1)
+            s.insert_text(at, f"w{i}-{rng.randint(0, 999)} ")
+
+
+def _counters_delta(before):
+    after = boot_counters().snapshot()
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(after) | set(before)}
+
+
+def _build_corpus(tmp_path, seed, docs=("a", "b"), head=30, tail=12):
+    """A durable-log + storage corpus: seeded edits, a summary +
+    checkpoint mid-stream, MORE edits after (the tail a lazy boot must
+    replay), then the server abandoned without close — a crash."""
+    log_dir = str(tmp_path / "log")
+    store_dir = str(tmp_path / "store")
+    server = LocalServer(log=DurableLog(log_dir), storage_dir=store_dir)
+    loader = Loader(LocalDocumentServiceFactory(server))
+    rng = random.Random(seed)
+    texts = {}
+    for doc in docs:
+        c = loader.resolve("t", doc)
+        s = c.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        _seeded_edits(s, rng, head)
+        _summarize(server, "t", doc)
+        _seeded_edits(s, rng, tail)  # the tail past the summary
+        texts[doc] = s.get_text()
+        assert texts[doc]
+    server.checkpoint_all()
+    server.log.flush()
+    # abandoned, not closed: the on-disk state is a SIGKILL's aftermath
+    return log_dir, store_dir, texts
+
+
+def _boot_text(log_dir, store_dir, doc, lazy):
+    server = LocalServer(log=DurableLog(log_dir), storage_dir=store_dir)
+    server.lazy_boot = lazy
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.resolve("t", doc)
+    ok = wait_for(
+        lambda: "default" in c.runtime.data_stores
+        and "text" in c.runtime.get_data_store("default").channels)
+    assert ok, f"doc {doc} never materialized after boot"
+    text = c.runtime.get_data_store("default").get_channel(
+        "text").get_text()
+    mode = server._orderers[f"t/{doc}"].boot_mode
+    return text, mode
+
+
+# =====================================================================
+# lazy rehydration == whole-log replay, to the byte
+# =====================================================================
+
+@pytest.mark.parametrize("seed", [0, 7, 42])
+def test_lazy_boot_equals_full_replay(tmp_path, seed):
+    log_dir, store_dir, texts = _build_corpus(tmp_path, seed)
+    lazy_dir = str(tmp_path / "lazy")
+    full_dir = str(tmp_path / "full")
+    shutil.copytree(log_dir, lazy_dir)
+    shutil.copytree(log_dir, full_dir)
+
+    before = boot_counters().snapshot()
+    for doc, want in texts.items():
+        lazy_text, lazy_mode = _boot_text(lazy_dir, store_dir, doc,
+                                          lazy=True)
+        full_text, full_mode = _boot_text(full_dir, store_dir, doc,
+                                          lazy=False)
+        assert lazy_mode == "lazy"
+        assert full_mode is None  # the untouched warm path
+        assert lazy_text == want
+        assert full_text == want
+    delta = _counters_delta(before)
+    assert delta.get("boot.part.lazy", 0) == len(texts)
+    # the contract the storm bench asserts fleet-wide: a checkpointed +
+    # summarized doc NEVER whole-log replays
+    assert delta.get("boot.part.full_replay", 0) == 0
+
+
+def test_unsummarized_doc_full_replays_and_converges(tmp_path):
+    """No checkpoint/summary → the safety fallback: identical to the
+    old boot (offset 0), counted as boot.part.full_replay."""
+    log_dir = str(tmp_path / "log")
+    server = LocalServer(log=DurableLog(log_dir))
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.resolve("t", "raw")
+    s = c.runtime.create_data_store("default").create_channel(
+        "text", "shared-string")
+    for i in range(10):
+        s.insert_text(0, f"x{i} ")
+    want = s.get_text()
+    server.log.flush()
+
+    before = boot_counters().snapshot()
+    text, mode = _boot_text(log_dir, str(tmp_path / "store"), "raw",
+                            lazy=True)
+    assert mode == "full_replay"
+    assert text == want
+    delta = _counters_delta(before)
+    assert delta.get("boot.part.full_replay", 0) == 1
+    assert delta.get("boot.part.lazy", 0) == 0
+
+
+def test_fresh_doc_counts_fresh(tmp_path):
+    server = LocalServer(log=DurableLog(str(tmp_path / "log")))
+    server.lazy_boot = True
+    before = boot_counters().snapshot()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.resolve("t", "newdoc")
+    c.runtime.create_data_store("default")
+    delta = _counters_delta(before)
+    assert delta.get("boot.part.fresh", 0) == 1
+    assert delta.get("boot.part.full_replay", 0) == 0
+
+
+# =====================================================================
+# boot-storm admission: park beyond the budget, then serve
+# =====================================================================
+
+def test_executor_parks_beyond_burst_then_serves():
+    now = [0.0]
+    ex = RehydrationExecutor(boots_per_s=10.0, burst=2,
+                             clock=lambda: now[0])
+    ex.admit("t", "d0")
+    ex.admit("t", "d1")
+    with pytest.raises(BootPending) as ei:
+        ex.admit("t", "d2")
+    assert ei.value.retry_after_ms > 0
+    assert ex.parked == 1 and ex.booted == 2
+    # the bucket refills with time: the parked boot's retry is served
+    now[0] += 0.2
+    ex.admit("t", "d2")
+    assert ex.booted == 3
+    st = ex.status()
+    assert st["booted"] == 3 and st["parked"] == 1
+
+
+def test_storm_parks_then_serves_in_connect_path(tmp_path):
+    """Through LocalServer.connect: warm docs bypass admission, cold
+    boots beyond the budget park with a retry hint."""
+    server = LocalServer(log=DurableLog(str(tmp_path / "log")))
+    server.lazy_boot = True
+    now = [0.0]
+    server.rehydrator = RehydrationExecutor(boots_per_s=10.0, burst=1,
+                                            clock=lambda: now[0])
+    before = boot_counters().snapshot()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    loader.resolve("t", "cold0")
+    with pytest.raises(BootPending):
+        loader.resolve("t", "cold1")
+    # a WARM doc is untouched by the storm gate — no token needed
+    loader.resolve("t", "cold0")
+    assert _counters_delta(before).get("boot.part.parked", 0) == 1
+    now[0] += 0.2
+    loader.resolve("t", "cold1")  # parked boot now serves
+    assert server.rehydrator.booted == 2
+
+
+def test_boot_pending_retries_transparently_over_network(tmp_path):
+    """The full lane: BootPending → error frame code=boot_pending →
+    driver parks on the jittered retry lane → connect succeeds."""
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.service.front_end import NetworkFrontEnd
+
+    from fluidframework_tpu.obs import tier_snapshot
+
+    server = LocalServer(log=DurableLog(str(tmp_path / "log")))
+    server.lazy_boot = True
+    fe = NetworkFrontEnd(server).start_background()
+    fe.enable_boot_admission(boots_per_s=5.0, burst=1)
+    before = tier_snapshot("driver").get("boot.parked.retries", 0)
+    try:
+        loader = Loader(NetworkDocumentServiceFactory(
+            "127.0.0.1", fe.port))
+        c0 = loader.resolve("t", "na")
+        c1 = loader.resolve("t", "nb")  # parked at least once, retried
+        s = c1.runtime.create_data_store("default").create_channel(
+            "text", "shared-string")
+        s.insert_text(0, "storm survivor")
+        assert wait_for(lambda: c1.runtime.pending.count == 0)
+        retries = tier_snapshot("driver").get("boot.parked.retries", 0)
+        assert retries - before >= 1
+        assert c0.connected and c1.connected
+    finally:
+        fe.stop()
+
+
+# =====================================================================
+# partition checkpoint isolation (one bad orderer ≠ zero checkpoints)
+# =====================================================================
+
+def test_partition_checkpoint_isolates_failures():
+    from fluidframework_tpu.service.core import InMemoryDb
+    from fluidframework_tpu.service.broadcaster import PubSub
+    from fluidframework_tpu.service.local_log import LocalLog
+    from fluidframework_tpu.service.partitions import Partition
+
+    part = Partition(0, LocalLog(), InMemoryDb(), PubSub())
+    o_bad = part.orderer("t", "bad")
+    o_good = part.orderer("t", "good")
+    calls = []
+    o_good_cp = o_good.checkpoint
+    o_good.checkpoint = lambda: (calls.append("good"), o_good_cp())[1]
+
+    def boom():
+        raise RuntimeError("disk full")
+    o_bad.checkpoint = boom
+
+    with pytest.raises(RuntimeError, match="disk full"):
+        part.checkpoint()
+    assert calls == ["good"]  # the healthy doc still checkpointed
+
+    # graceful close: same isolation, and EVERY orderer still closes
+    closed = []
+    for key, o in part.orderers.items():
+        o_close = o.close
+
+        def close(key=key, o_close=o_close):
+            closed.append(key)
+            o_close()
+        o.close = close
+    with pytest.raises(RuntimeError, match="disk full"):
+        part.close(graceful=True)
+    assert sorted(closed) == ["t/bad", "t/good"]
+    assert not part.orderers
+
+
+# =====================================================================
+# topology spec: round-trip, and the fleet it declares
+# =====================================================================
+
+def test_topology_spec_round_trips(tmp_path):
+    from fluidframework_tpu.service.topology import (
+        GatewaySpec,
+        TopologySpec,
+        default_spec,
+    )
+
+    spec = default_spec(str(tmp_path / "fleet"), n_cores=3,
+                        n_partitions=8, lease_ttl=2.5,
+                        summarize_every=50, boot_rate=77.0,
+                        boot_burst=9)
+    spec.gateways = [GatewaySpec(name="gw0"),
+                     GatewaySpec(name="gw1", upstream=0)]
+    path = str(tmp_path / "topology.json")
+    spec.save(path)
+    loaded = TopologySpec.load(path)
+    assert loaded == spec
+    assert loaded.to_dict() == spec.to_dict()
+    # partitions are fully covered, disjointly, by the core prefers
+    claimed = [k for c in loaded.cores for k in c.prefer]
+    assert sorted(claimed) == list(range(8))
+
+
+def test_fleet_from_spec_claims_declared_partitions(tmp_path):
+    from fluidframework_tpu.service.placement_plane import EpochTable
+    from fluidframework_tpu.service.topology import Fleet, default_spec
+
+    spec = default_spec(str(tmp_path / "fleet"), n_cores=2,
+                        n_partitions=4, lease_ttl=1.0)
+    before = boot_counters().snapshot()
+    fl = Fleet(spec).start()
+    try:
+        fl.wait_claimed()
+        table = EpochTable.for_shard_dir(spec.shard_dir).read()
+        # spec → running fleet → spec: the table's claim map IS the
+        # spec's prefer map, per core address
+        addr_of_core = {i: f"{spec.host}:{fl.core_ports[i]}"
+                        for i in fl.core_ports}
+        for i, core in enumerate(spec.cores):
+            for k in core.prefer:
+                assert table["parts"][str(k)]["addr"] == addr_of_core[i]
+        delta = _counters_delta(before)
+        assert delta.get("topology.fleet.starts", 0) == 1
+        assert delta.get("topology.core.spawns", 0) == 2
+    finally:
+        fl.stop()

@@ -267,3 +267,63 @@ def test_durable_restart_with_truncated_retention(tmp_path):
     assert first_kept is None or first_kept > base
     s2.insert_text(0, "alive ")
     assert s2.get_text().startswith("alive ")
+
+def test_oplog_fd_cap_bounds_open_files(tmp_path):
+    """The handle LRU keeps concurrently open FILE*s under the cap while
+    topic metadata stays resident: evicted topics reopen transparently on
+    the next touch, and sync() still covers records appended before an
+    eviction (the evicted_unsynced fsync pass)."""
+    from fluidframework_tpu.native import NativeOpLog
+
+    path = str(tmp_path / "log")
+    log = NativeOpLog(path)
+    log.fd_cap(20)
+    for i in range(100):
+        log.append(f"topic-{i}", f"first-{i}".encode())
+    assert 0 < log.open_files() <= 20
+    # touch every topic again: cold handles reopen, hot ones evict
+    for i in range(100):
+        log.append(f"topic-{i}", f"second-{i}".encode())
+    assert log.open_files() <= 20
+    log.sync()  # must fsync evicted-while-unsynced topics too
+    for i in range(0, 100, 7):
+        assert log.read(f"topic-{i}", 0) == f"first-{i}".encode()
+        assert log.read(f"topic-{i}", 1) == f"second-{i}".encode()
+    assert log.open_files() <= 20
+    log.close()
+
+    # everything survived the churn durably
+    log2 = NativeOpLog(path)
+    for i in range(100):
+        assert log2.length(f"topic-{i}") == 2
+        assert log2.read(f"topic-{i}", 1) == f"second-{i}".encode()
+    log2.close()
+
+
+def test_oplog_fd_cap_bounds_segment_streams(tmp_path):
+    """Segment streams ride the same fd budget as record topics; eviction
+    must not lose resident block metadata or the ability to keep
+    appending to a stream whose tail segment was closed."""
+    from fluidframework_tpu.native import NativeOpLog
+
+    path = str(tmp_path / "log")
+    log = NativeOpLog(path)
+    log.fd_cap(16)
+    for i in range(40):
+        log.seg_append(f"stream-{i}", 1, 2, f"blk-a-{i}".encode(), 0)
+    assert log.open_files() <= 16
+    for i in range(40):
+        log.seg_append(f"stream-{i}", 3, 4, f"blk-b-{i}".encode(), 0)
+    log.sync()
+    for i in range(0, 40, 5):
+        assert log.seg_count(f"stream-{i}") == 2
+        assert log.seg_read(f"stream-{i}", 0) == f"blk-a-{i}".encode()
+        assert log.seg_read(f"stream-{i}", 1) == f"blk-b-{i}".encode()
+    assert log.open_files() <= 16
+    log.close()
+
+    log2 = NativeOpLog(path)
+    for i in range(40):
+        assert log2.seg_count(f"stream-{i}") == 2
+        assert log2.seg_read(f"stream-{i}", 1) == f"blk-b-{i}".encode()
+    log2.close()

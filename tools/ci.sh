@@ -70,6 +70,13 @@ if [ "$run_soak" = 1 ]; then
     # core kill -9 + auto-heal phase (run manually before release)
     python -m fluidframework_tpu.chaos.rebalance --seed 0 --quick
     echo "rebalance: ok"
+    echo "--- chaos cold-start campaign (fixed seed, quick)"
+    # full-cluster kill -9 mid-traffic, restart twice from the same
+    # topology spec (once with the rehydration crash seam armed),
+    # exact-once token audit + boot.part.full_replay == 0 fleet-wide;
+    # full-mode seeds 0/7/42 run manually before release
+    python -m fluidframework_tpu.chaos.coldstart --seed 0 --quick
+    echo "coldstart: ok"
 fi
 
 echo "ci: all gates passed"

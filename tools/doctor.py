@@ -26,9 +26,12 @@ The doctor joins these into a triage report:
    draining/drained cores still owning partitions, migration failures,
    rebalance suppression storms, version-skew hop drops
    (``obs.trace.unknown_hops``), disarmed journals, journal write
-   errors, and static-contract violations in the capturing build
-   (a dirty ``lint.json`` in production is an incident signal of its
-   own — someone deployed past the gate).
+   errors, cold-start regressions from ``boot.json`` (a doc that paid
+   a whole-log replay, or parked boots idling against a refilled
+   admission bucket — the storm stalled), and static-contract
+   violations in the capturing build (a dirty ``lint.json`` in
+   production is an incident signal of its own — someone deployed past
+   the gate).
 
 Read-only; exit 0 with "healthy" when nothing needs attention, exit 1
 when any anomaly or active SLO burn was found (so a CI gate can assert
@@ -186,6 +189,32 @@ def diagnose(bundle_dir: str) -> dict:
         for r in slo.get("slos", []):
             if r.get("state") != "ok":
                 report["slo_burn"].append({"core": owner, **r})
+        # cold-start surface: rehydration progress at capture time
+        boot = _load_json(os.path.join(cdir, "boot.json"))
+        if boot is not None:
+            ex = boot.get("executor") or {}
+            booted = sum(p.get("docs_booted", 0)
+                         for p in boot.get("parts", []))
+            pending = sum(p.get("docs_pending", 0)
+                          for p in boot.get("parts", []))
+            row["boot"] = {"booted": booted, "pending": pending,
+                           "parked": ex.get("parked", 0)}
+            replays = (boot.get("counters") or {}).get(
+                "boot.part.full_replay", 0)
+            if replays:
+                anomalies.append(
+                    f"core {owner}: {replays} doc boot(s) paid a "
+                    "WHOLE-LOG replay — a summary or checkpoint is "
+                    "missing, so the cold-start bound is gone for "
+                    "those docs")
+            if (pending and ex.get("parked", 0)
+                    and ex.get("tokens", 0) >= 1):
+                anomalies.append(
+                    f"core {owner}: {pending} doc(s) still pending "
+                    f"with {ex['parked']} boot(s) parked against a "
+                    "refilled admission bucket — the storm stalled "
+                    "(clients gave up retrying, or first routes never "
+                    "arrived)")
         # suppression storm: longest run of rebalance.suppressed
         # without an actionable plan breaking it
         run = best = 0
@@ -248,6 +277,10 @@ def print_report(report: dict) -> None:
         extra = ""
         if row.get("recoveries"):
             extra += f"  recoveries={row['recoveries']}"
+        if row.get("boot"):
+            b = row["boot"]
+            extra += (f"  boot={b['booted']}/"
+                      f"{b['booted'] + b['pending']}")
         if row.get("error"):
             extra += "  CAPTURE-ERROR"
         print(f"  core {owner} @ {row.get('addr', '?')}"

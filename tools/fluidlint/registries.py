@@ -102,11 +102,26 @@ LOCKED_FAMILIES = {
     "net.admission.": frozenset({"net.admission.shed",
                                  "net.admission.delayed"}),
     # the snapshot fast-boot plane: the net-smoke catch-up gate, the
-    # join-storm bench, and the chaos soak all key on these exact names
+    # join-storm bench, and the chaos soak all key on these exact names;
+    # boot.part.* witness the fleet cold-start contract (lazy == every
+    # existing doc booted O(snapshot+tail), full_replay == the count the
+    # cold-storm bench and net_smoke gate assert ZERO) and
+    # boot.parked.retries is the driver's storm-admission retry lane
+    # (service/rehydrate.py, service/local_orderer.py)
     "boot.": frozenset({"boot.snapshot.used", "boot.snapshot.fallback",
                         "boot.snapshot.reanchor", "boot.backfill.bounded",
                         "boot.backfill.full", "boot.chunks.fetched",
-                        "boot.chunks.cached"}),
+                        "boot.chunks.cached",
+                        "boot.part.lazy", "boot.part.full_replay",
+                        "boot.part.fresh", "boot.part.parked",
+                        "boot.parked.retries"}),
+    # the topology spec / fleet launcher (service/topology.py): the
+    # cold-storm bench and the coldstart chaos drill key on these to
+    # prove restarts really went through the one declarative spec
+    "topology.": frozenset({"topology.fleet.starts",
+                            "topology.fleet.restarts",
+                            "topology.fleet.kills",
+                            "topology.core.spawns"}),
     "storage.snapshot.": frozenset({"storage.snapshot.encodes",
                                     "storage.snapshot.cache_hits",
                                     "storage.snapshot.served",

@@ -478,6 +478,119 @@ def history_gate() -> dict:
         front.stop()
 
 
+def coldstart_gate() -> dict:
+    """Fleet cold start, in process: a 2-core topology built from ONE
+    TopologySpec, killed outright (no checkpoint beyond the last
+    ticker-equivalent pass) and restarted from the same spec object
+    under live traffic — reconnecting writers ARE the boot storm.
+    Counter-asserts the rehydration contract: every summarized doc in
+    the restarted generation boots lazily from its snapshot + durable
+    tail (``boot.part.lazy`` rises, ``boot.part.full_replay`` stays 0)
+    and the topology counters account for the restart."""
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+        _Transport,
+    )
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.placement_plane import EpochTable
+    from fluidframework_tpu.service.rehydrate import boot_counters
+    from fluidframework_tpu.service.stage_runner import doc_partition
+    from fluidframework_tpu.service.topology import Fleet, default_spec
+
+    n_docs, n_parts = 3, 4
+    work = tempfile.mkdtemp(prefix="net-smoke-cold-")
+    fl = None
+    containers = []
+    try:
+        spec = default_spec(os.path.join(work, "fleet"), n_cores=2,
+                            n_partitions=n_parts, lease_ttl=0.75,
+                            summarize_every=10 ** 6)
+        fl = Fleet(spec).start()
+        fl.wait_claimed()
+
+        def port_for(doc: str) -> int:
+            k = doc_partition("smoke", doc, n_parts)
+            rec = EpochTable.for_shard_dir(
+                spec.shard_dir).read()["parts"][str(k)]
+            return int(rec["addr"].rsplit(":", 1)[1])
+
+        def dial(doc: str):
+            c = Loader(NetworkDocumentServiceFactory(
+                "127.0.0.1", port_for(doc))).resolve("smoke", doc)
+            containers.append(c)
+            return c
+
+        docs = [f"cold{i}" for i in range(n_docs)]
+        texts = {}
+        for doc in docs:
+            c = dial(doc)
+            sstr = c.runtime.create_data_store(
+                "default").create_channel("text", "shared-string")
+            for i in range(40):
+                sstr.insert_text(0, f"{doc}.{i} ")
+            if not wait_for(lambda: c.runtime.pending.count == 0):
+                raise AssertionError(
+                    f"coldstart gate: {doc} never quiesced pre-kill")
+            texts[doc] = sstr.get_text()
+        for doc in docs:
+            t = _Transport("127.0.0.1", port_for(doc))
+            try:
+                t.request_rid({"t": "admin_summarize", "tenant": "smoke",
+                               "doc": doc})
+            finally:
+                t.close()
+        fl.checkpoint_all()
+
+        before = boot_counters().snapshot()
+        fl.restart()
+        fl.wait_claimed()
+
+        # reconnect UNDER the storm: each resolve is a first route that
+        # lazily boots its doc, and fresh edits ride straight in
+        for doc in docs:
+            c = dial(doc)
+            sstr = c.runtime.get_data_store("default").get_channel("text")
+            sstr.insert_text(0, "post ")
+            if not wait_for(lambda: c.runtime.pending.count == 0
+                            and sstr.get_text() == "post " + texts[doc]):
+                raise AssertionError(
+                    f"coldstart gate: {doc} did not converge on its "
+                    f"pre-kill text after the restart "
+                    f"({len(sstr.get_text())} vs {len(texts[doc]) + 5})")
+
+        after = boot_counters().snapshot()
+
+        def _delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        if _delta("boot.part.full_replay"):
+            raise AssertionError(
+                "coldstart gate: a summarized + checkpointed doc "
+                f"whole-log replayed ({_delta('boot.part.full_replay')} "
+                "full replays in the restarted generation)")
+        if _delta("boot.part.lazy") < n_docs:
+            raise AssertionError(
+                f"coldstart gate: expected >= {n_docs} lazy boots after "
+                f"the restart, saw {_delta('boot.part.lazy')}")
+        return {
+            "boot.part.lazy": _delta("boot.part.lazy"),
+            "topology.fleet.restarts": _delta("topology.fleet.restarts"),
+            "topology.core.spawns": _delta("topology.core.spawns"),
+        }
+    finally:
+        for c in containers:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if fl is not None:
+            fl.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from fluidframework_tpu.driver.network import (
@@ -800,6 +913,15 @@ def main() -> int:
     # read, integrate one fork edit back — all three counters nonzero
     try:
         checks.update(history_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+
+    # fleet cold start from one topology spec (in-proc 2-core fleet,
+    # killed + restarted under live traffic): every summarized doc
+    # boots lazily, zero whole-log replays
+    try:
+        checks.update(coldstart_gate())
     except AssertionError as e:
         print(f"net_smoke: FAIL — {e}", file=sys.stderr)
         return 1
