@@ -2241,6 +2241,277 @@ def bench_net_cold_storm() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_net_multihost() -> dict:
+    """Weak scaling across simulated host groups: 1 → 2 → 4 hosts, one
+    core + one gateway each, per-host offered load held constant.
+
+    Each fleet comes from one ``multihost_spec`` (service/topology.py):
+    ``h0`` is the placement host (shard dir, storage tier, table door);
+    every other group runs in a DISJOINT working dir with its cores on
+    ``RemoteTableClient`` — the lease/epoch plane reached only over the
+    ``admin_table_*`` door. Per axis point:
+
+    - **ops/s, total and per host**: every host's gateway carries the
+      same load mix — 4 docs owned by its OWN host (doc names mined so
+      their partitions land in that host's pinned prefer set) plus, on
+      multi-host points, 2 docs owned by the NEXT host. Weak-scaling
+      efficiency = total(H) / (H × total(1)).
+    - **same-host vs cross-host ack + hop p99**: the mined prefixes
+      classify every worker as same- or cross-host at its entry
+      gateway, so the ack split (and the per-hop-pair taxonomy split,
+      trace tails sampled 1-in-16) is exact, not inferred.
+    - **locality hit rate**: ``fanout.upstream.same_host /
+      (same_host + cross_host)`` summed over the gateways' own counter
+      scrape — the host-aware routing proof.
+    - **disjointness, in-bench (hard)**: every remote-group process's
+      ``/proc/<pid>/fd`` table is scanned — an fd open under the
+      placement host's shard dir fails the bench (remote groups share
+      sockets, never files), and remote working dirs must contain no
+      ``placement/`` lease/table state at all.
+    - **the remote-table boot path (hard)**: at the 2-host point the
+      h1 group is kill -9'd (its own process group) and respawned from
+      its spec copy; its checkpointed docs must re-serve with
+      ``boot.part.full_replay == 0`` — lazy O(snapshot+tail) boots
+      through the door, not through any shared file.
+    """
+    import os
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from fluidframework_tpu.driver.network import _Transport
+    from fluidframework_tpu.service.stage_runner import doc_partition
+    from fluidframework_tpu.service.topology import Fleet, multihost_spec
+
+    axis = [1, 2, 4]
+    parts_per_host = 4
+    docs_same, docs_cross = 4, 2
+    rate, batch, rounds = 2.0, 8, 24
+    host_limited = (os.cpu_count() or 1) < 4
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        return round(vals[int(p * (len(vals) - 1))], 3) if vals else None
+
+    def fr(obj):
+        body = json.dumps(obj, separators=(",", ":")).encode()
+        return len(body).to_bytes(4, "big") + body
+
+    def read_frame(s, buf):
+        while True:
+            if len(buf[0]) >= 4:
+                n = int.from_bytes(buf[0][:4], "big")
+                if len(buf[0]) >= 4 + n:
+                    body, buf[0] = buf[0][4:4 + n], buf[0][4 + n:]
+                    return json.loads(body)
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("multihost socket closed")
+            buf[0] += chunk
+
+    def mine_prefix(tag, owner_parts, n_docs, n_parts):
+        """A doc prefix whose first n_docs docs ALL partition into
+        owner_parts — exact entry-gateway-vs-owner classification."""
+        for t in range(200_000):
+            p = f"{tag}x{t}d"
+            if all(doc_partition("bench", f"{p}{d}", n_parts)
+                   in owner_parts for d in range(n_docs)):
+                return p
+        raise AssertionError(f"no prefix mined for {tag}")
+
+    def gw_counters(addr):
+        s = _socket.create_connection(addr, timeout=10)
+        buf = [b""]
+        try:
+            s.sendall(fr({"t": "gateway_counters", "rid": 1}))
+            reply = read_frame(s, buf)
+            while reply.get("rid") != 1:
+                reply = read_frame(s, buf)
+            return reply["counters"]
+        finally:
+            s.close()
+
+    def run_point(root, n_hosts):
+        n_parts = parts_per_host * n_hosts
+        spec = multihost_spec(
+            os.path.join(root, f"fleet{n_hosts}"), n_hosts, 1, n_parts,
+            lease_ttl=6.0, summarize_every=10 ** 6)
+        host_parts = {h: set(spec.cores[h].prefer)
+                      for h in range(n_hosts)}
+        fl = Fleet(spec, subprocess=True).start()
+        try:
+            fl.wait_claimed()
+
+            # one load worker per (gateway, locality class)
+            plans = []  # (host, cls, prefix, docs)
+            for h in range(n_hosts):
+                plans.append((h, "same", mine_prefix(
+                    f"mh{n_hosts}s{h}", host_parts[h], docs_same,
+                    n_parts), docs_same))
+                if n_hosts > 1:
+                    plans.append((h, "cross", mine_prefix(
+                        f"mh{n_hosts}c{h}",
+                        host_parts[(h + 1) % n_hosts], docs_cross,
+                        n_parts), docs_cross))
+            start_at = _time.time() + 6.0
+            workers = []
+            for w, (h, cls, prefix, docs) in enumerate(plans):
+                gh, gp = fl.gateway_addr(h)
+                workers.append((cls, subprocess.Popen(
+                    _lean_cmd("fluidframework_tpu.service.load_async",
+                              "--host", gh, "--port", str(gp),
+                              "--docs", str(docs),
+                              "--clients-per-doc", "1",
+                              "--rounds", str(rounds),
+                              "--batch", str(batch),
+                              "--rate", str(rate), "--seed", str(w),
+                              "--start-at", str(start_at),
+                              "--doc-prefix", prefix),
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, cwd=REPO, env=_lean_env())))
+            lats = {"same": [], "cross": []}
+            hops = {"same": {}, "cross": {}}
+            ops = acked = 0
+            secs = 0.0
+            for cls, w in workers:
+                out, _ = w.communicate(timeout=300)
+                r = json.loads(out)
+                lats[cls].extend(r["lat_ms"])
+                for k, v in r["hops"].items():
+                    hops[cls].setdefault(k, []).extend(v)
+                ops += r["ops"]
+                acked += r["acked"]
+                secs = max(secs, r["seconds"])
+                assert not r["errors"], (n_hosts, cls, r["errors"][:3])
+            assert acked == ops, (n_hosts, acked, ops)
+
+            # locality hit rate from the gateways' OWN counters
+            same = cross = 0
+            for h in range(n_hosts):
+                c = gw_counters(fl.gateway_addr(h))
+                same += c.get("fanout.upstream.same_host", 0)
+                cross += c.get("fanout.upstream.cross_host", 0)
+            assert same > 0, "no same-host routes counted"
+            if n_hosts > 1:
+                assert cross > 0, "cross-host workers counted no " \
+                                  "cross-host routes"
+
+            # disjointness: remote groups share SOCKETS, never files —
+            # no remote-group fd may be open under the placement dir,
+            # and no placement/lease/table state may exist in a remote
+            # working dir (the placement dir is effectively unreadable
+            # to them: nothing ever opened it)
+            canon = os.path.join(spec.shard_dir, "")
+            leaked = []
+            for hid, procs in fl.host_procs.items():
+                if not spec.host_is_remote(hid):
+                    continue
+                for p in procs:
+                    fd_dir = f"/proc/{p.pid}/fd"
+                    for fd in os.listdir(fd_dir):
+                        try:
+                            tgt = os.readlink(os.path.join(fd_dir, fd))
+                        except OSError:
+                            continue
+                        if tgt.startswith(canon):
+                            leaked.append((hid, p.pid, tgt))
+                entries = os.listdir(spec.host_dir(hid))
+                assert "placement" not in entries, \
+                    (f"host {hid} grew local placement state: "
+                     f"{entries}")
+            assert not leaked, \
+                f"remote groups touched placement-host files: {leaked}"
+
+            # the remote-table boot path: kill -9 the h1 group, respawn
+            # it from its spec copy, and every checkpointed doc must
+            # lazy-boot (zero whole-log replays) through the door
+            replay = lazy = None
+            if n_hosts == 2:
+                h1_prefix = next(p for h, cls, p, _ in plans
+                                 if h == 1 and cls == "same")
+                t = _Transport("127.0.0.1", fl.core_ports[1],
+                               timeout=30.0)
+                try:
+                    for d in range(docs_same):
+                        t.request_rid({"t": "admin_summarize",
+                                       "tenant": "bench",
+                                       "doc": f"{h1_prefix}{d}"})
+                finally:
+                    t.close()
+                _time.sleep(3.0)  # two checkpoint-ticker passes
+                fl.kill_host("h1")
+                fl.start_host("h1")
+                fl.wait_claimed(parts=host_parts[1], timeout=60.0)
+                for d in range(docs_same):
+                    s = _socket.create_connection(
+                        ("127.0.0.1", fl.core_ports[1]), timeout=30)
+                    buf = [b""]
+                    s.sendall(fr({"t": "connect", "tenant": "bench",
+                                  "doc": f"{h1_prefix}{d}", "rid": 1,
+                                  "bin": 0, "readonly": 1}))
+                    reply = read_frame(s, buf)
+                    while reply.get("rid") != 1:
+                        reply = read_frame(s, buf)
+                    s.close()
+                    if (reply.get("t") == "error"
+                            and reply.get("code") == "boot_pending"):
+                        _time.sleep(
+                            (reply.get("retryAfterMs") or 50) / 1000)
+                t = _Transport("127.0.0.1", fl.core_ports[1],
+                               timeout=30.0)
+                try:
+                    _, rep = t.request_rid({"t": "admin_boot_status"})
+                finally:
+                    t.close()
+                tot = rep["boot"]["counters"]
+                replay = tot.get("boot.part.full_replay", 0)
+                lazy = tot.get("boot.part.lazy", 0)
+                assert replay == 0, \
+                    (f"{replay} whole-log replays through the "
+                     f"remote-table boot path: {tot}")
+
+            row = {
+                "hosts": n_hosts,
+                "partitions": n_parts,
+                "ops_per_sec": round(ops / secs, 1) if secs else 0.0,
+                "ops_per_sec_per_host":
+                    round(ops / secs / n_hosts, 1) if secs else 0.0,
+                "same_host_ack_p99_ms": pct(lats["same"], 0.99),
+                "cross_host_ack_p99_ms": pct(lats["cross"], 0.99),
+                "hop_p99_ms": {
+                    cls: {name: pct(v, 0.99)
+                          for name, v in hv.items()}
+                    for cls, hv in hops.items() if hv},
+                "locality": {
+                    "same_host_routes": same,
+                    "cross_host_routes": cross,
+                    "hit_rate": round(same / max(same + cross, 1), 3)},
+                "remote_fd_leaks": 0,
+            }
+            if replay is not None:
+                row["host_restart"] = {
+                    "boot_part_full_replay": replay,
+                    "boot_part_lazy": lazy}
+            return row
+        finally:
+            fl.stop()
+
+    root = tempfile.mkdtemp(prefix="bench-multihost-")
+    try:
+        rows = [run_point(root, h) for h in axis]
+        base = rows[0]["ops_per_sec"] or 1e-9
+        for r in rows[1:]:
+            r["weak_scaling_efficiency"] = round(
+                r["ops_per_sec"] / (r["hosts"] * base), 3)
+        return {"axis": rows, "cores_per_host": 1,
+                "rate_hz_per_client": rate,
+                "host_limited": host_limited}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_multichip() -> dict:
     """Per-device scaling of the doc-mesh lane (tools/bench_multichip):
     docs axis 1→2→4→8 on forced host devices, in a FRESH process — XLA
@@ -2282,6 +2553,7 @@ def main() -> None:
     rebalance_storm = bench_net_rebalance_storm()
     fork_storm = bench_net_fork_storm()
     cold_storm = bench_net_cold_storm()
+    multihost = bench_net_multihost()
     kernel_ops, kernel_xla_ops = bench_kernel()
     scalar_deli = bench_scalar_deli()
     service = bench_service()
@@ -2426,6 +2698,14 @@ def main() -> None:
                 # boot.part.full_replay == 0 asserted in-bench (every
                 # boot is snapshot + durable tail, never whole log)
                 "net_cold_storm": cold_storm,
+                # weak scaling across simulated host groups (1→2→4):
+                # per-host load constant, cores on RemoteTableClient
+                # through the admin_table_* door, same- vs cross-host
+                # ack/hop p99 split, gateway locality hit rate, /proc
+                # fd-scanned file disjointness, and full_replay == 0
+                # through the remote-table boot path after a host-group
+                # kill -9 + respawn
+                "net_multihost": multihost,
                 # per-device scaling of the doc-mesh applier lane (docs
                 # axis 1→2→4→8, forced host devices; full artifact in
                 # MULTICHIP_r06.json). mesh_vs_local_1shard is the mesh

@@ -67,6 +67,7 @@ KINDS = {
     "migration.seal": "partition sealed for migration (submits bounced)",
     "migration.fence": "migration fenced the partition's final seq",
     "migration.checkpoint": "sealed partition checkpointed + flushed",
+    "migration.ship": "sealed log dir shipped cross-host via storage",
     "migration.adopt": "target core adopted the partition",
     "migration.commit": "migration committed (lease transferred)",
     "migration.fail": "migration failed and the source reclaimed",

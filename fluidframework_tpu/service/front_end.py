@@ -1265,7 +1265,8 @@ class _ClientSession:
                 raise ValueError("not a sharded core")
             result = front.migration_engine.adopt(
                 int(frame["k"]), frame["from_owner"],
-                cause=frame.get("journal_cause"))
+                cause=frame.get("journal_cause"),
+                log_blob=frame.get("log_blob"))
             self.push("admin", {"rid": rid, **result})
         elif t == "admin_core_heat":
             # read-only: this core's windowed per-partition heat — the
@@ -1484,13 +1485,13 @@ class ShardHost:
     """
 
     def __init__(self, shard_dir: str, n: int, prefer=(),
-                 storage_server=None, ttl_s: float = None):
+                 storage_server=None, ttl_s: float = None,
+                 table_client=None, host_id: Optional[str] = None,
+                 claim_policy: Optional[str] = None):
         import os
         import uuid
 
-        from .placement import DEFAULT_TTL_S, PlacementDir
-
-        from .placement_plane import EpochTable
+        from .placement import DEFAULT_TTL_S
 
         self.shard_dir = shard_dir
         self.n = n
@@ -1498,14 +1499,33 @@ class ShardHost:
         self.storage_server = storage_server
         self.owner_id = uuid.uuid4().hex[:8]
         self.address: Optional[str] = None  # set once the port is bound
-        self.placement = PlacementDir(
-            os.path.join(shard_dir, "placement"), n,
-            ttl_s if ttl_s is not None else DEFAULT_TTL_S)
-        # epoch-numbered routing table (placement_plane): every claim /
-        # release / migration adoption this host performs is recorded
-        # there, so gateways route from one mtime-cached file instead of
-        # per-request lease reads
-        self.table = EpochTable.for_shard_dir(shard_dir)
+        # placement plane behind the TableClient split (table_client.py):
+        # local (the raw flock-backed PlacementDir + EpochTable — zero
+        # indirection) unless a remote client was injected, in which case
+        # every lease/table op is an RPC into the placement host's table
+        # door and the flock runs THERE. Either way ``self.placement`` /
+        # ``self.table`` keep their historical shapes, so the fencing
+        # layers below are implementation-blind.
+        if table_client is None:
+            from .table_client import LocalTableClient
+
+            table_client = LocalTableClient(
+                shard_dir, n,
+                ttl_s if ttl_s is not None else DEFAULT_TTL_S)
+        self.table_client = table_client
+        self.placement = table_client.leases
+        self.table = table_client.table
+        # multi-host fleets: which host group this core runs in (None =
+        # classic single-host). Advertised in the table's cores rows for
+        # the rebalancer's locality tiebreak and gateway accounting.
+        self.host_id = host_id
+        # "prefer" pins this core to its preferred partitions — it never
+        # claims outside them, not even stale leases. Multi-host fleets
+        # without log replication run this way: a partition's durable
+        # log lives in ONE host group's dir, so a cross-host takeover
+        # (unlike a cross-host MIGRATION, which ships the log) could not
+        # resume it. claim_policy=None/"any" is the historical behavior.
+        self.claim_policy = claim_policy or "any"
         # epoch under which this host claimed each owned partition vs
         # the latest table epoch seen for it (refreshed once per poll):
         # table newer than claim ⇒ someone adopted it ⇒ deli's epoch
@@ -1640,7 +1660,8 @@ class ShardHost:
             # membership: advertise this core (no-op when unchanged) and
             # pick up an operator drain mark — a draining host stops
             # claiming; the rebalancer evacuates what it still owns
-            self.table.record_core(self.owner_id, self.address)
+            self.table.record_core(self.owner_id, self.address,
+                                   host=self.host_id)
             from .placement_plane import CORE_DRAINED, CORE_DRAINING
 
             self.draining = self.table.core_state(self.owner_id) in (
@@ -1673,6 +1694,8 @@ class ShardHost:
         for k in range(self.n):
             if k in self.servers or k in self.migrating:
                 continue
+            if k not in self.prefer and self.claim_policy == "prefer":
+                continue  # pinned: this core's logs can't serve others
             if k not in self.prefer and in_grace:
                 continue  # let the preferring core take it first
             if self.placement.try_claim(k, self.owner_id, self.address):

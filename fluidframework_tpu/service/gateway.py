@@ -388,7 +388,9 @@ class Gateway:
 
     def __init__(self, core_host: str, core_port: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 shard_dir: Optional[str] = None, shards: int = 0):
+                 shard_dir: Optional[str] = None, shards: int = 0,
+                 table_server: Optional[str] = None,
+                 host_id: Optional[str] = None):
         self.core_host, self.core_port = core_host, core_port
         self.host, self.port = host, port
         self.sessions: dict[int, _GatewaySession] = {}
@@ -398,6 +400,13 @@ class Gateway:
         self._pending: dict[int, asyncio.Future] = {}
         self.placement = None
         self.routing = None
+        # multi-host: which host group this gateway runs in; with a
+        # table set, every route resolution is classified same-host vs
+        # cross-host (fanout.upstream.same_host / .cross_host) — the
+        # weak-scaling bench's locality hit rate
+        self.host_id = host_id
+        self._table = None
+        self._addr_hosts: dict = {}
         if shard_dir is not None:
             import os
 
@@ -409,8 +418,21 @@ class Gateway:
             # hot-path routing: in-memory dict, epoch-table refresh on
             # miss, lease read only as the liveness fallback — replaces
             # the old per-connect owner_of poll (placement_plane)
-            self.routing = RoutingCache(
-                self.placement, EpochTable.for_shard_dir(shard_dir))
+            self._table = EpochTable.for_shard_dir(shard_dir)
+            self.routing = RoutingCache(self.placement, self._table)
+        elif table_server:
+            # remote host group: no placement dir to read — the same
+            # RoutingCache machinery runs over RPC proxies against the
+            # placement host's table door (table_client.py); epoch-gated
+            # fplacement pushes are the cache-coherence protocol either
+            # way
+            from .placement_plane import RoutingCache
+            from .table_client import RemoteTableClient
+
+            client = RemoteTableClient(table_server, shards)
+            self.placement = client.leases
+            self._table = client.table
+            self.routing = RoutingCache(self.placement, self._table)
         self._upstreams: dict[str, _Upstream] = {}
         self._upstream_dials: dict[str, "asyncio.Future"] = {}
         self._up_default: Optional[_Upstream] = None
@@ -486,6 +508,11 @@ class Gateway:
         while True:
             addr = self.routing.resolve(k)
             if addr is not None:
+                if self.host_id is not None:
+                    self.counters.inc(
+                        "fanout.upstream.same_host"
+                        if self._host_of_addr(addr) == self.host_id
+                        else "fanout.upstream.cross_host")
                 try:
                     return await self._open_upstream(addr)
                 except OSError:
@@ -496,6 +523,18 @@ class Gateway:
                 raise ConnectionError(
                     f"no live core owns partition {k}")
             await asyncio.sleep(0.2)
+
+    def _host_of_addr(self, addr: str):
+        """Which host group advertises ``addr`` in the table's cores
+        rows (lazily cached — membership changes re-resolve on miss)."""
+        h = self._addr_hosts.get(addr)
+        if h is None and self._table is not None:
+            for row in self._table.cores().values():
+                a = row.get("addr")
+                if a:
+                    self._addr_hosts[a] = row.get("host") or ""
+            h = self._addr_hosts.get(addr)
+        return h or None
 
     def note_route_failure(self, tenant: str, doc: str) -> None:
         """A core refused the doc (``not the owner`` after a migration
@@ -841,6 +880,14 @@ def main() -> None:
                         "docs route to their partition's owning core")
     p.add_argument("--shards", type=int, default=0,
                    help="number of doc partitions in the sharded core")
+    p.add_argument("--table-server", default=None, metavar="HOST:PORT",
+                   help="remote-host deployment: route from the "
+                        "placement host's table door (admin_table_*) "
+                        "instead of a local --shard-dir")
+    p.add_argument("--host-id", default=None,
+                   help="this gateway's host group id (multi-host "
+                        "fleets): routes are counted same- vs "
+                        "cross-host for the locality hit rate")
     p.add_argument("--upstream-gateway", default=None, metavar="HOST:PORT",
                    help="relay-tree mode: dial a PARENT GATEWAY as the "
                         "upstream instead of a core — fan-out bytes "
@@ -856,10 +903,12 @@ def main() -> None:
         host, _, port = args.upstream_gateway.rpartition(":")
         args.core_host, args.core_port = host or "127.0.0.1", int(port)
         args.python = True
-    if args.shard_dir is None and not args.core_port:
-        p.error("--core-port is required without --shard-dir "
-                "(or --upstream-gateway)")
-    if not args.python and args.shard_dir is None:
+    if args.shard_dir is None and args.table_server is None \
+            and not args.core_port:
+        p.error("--core-port is required without --shard-dir / "
+                "--table-server (or --upstream-gateway)")
+    if not args.python and args.shard_dir is None \
+            and args.table_server is None:
         # default: the C++ epoll relay (native/gateway.cpp) — zero
         # Python on the hot path (VERDICT r4 #3, SURVEY §2.9). Falls
         # back to asyncio if the toolchain can't build it.
@@ -883,7 +932,9 @@ def main() -> None:
     gc.disable()
     Gateway(args.core_host, args.core_port,
             host=args.host, port=args.port,
-            shard_dir=args.shard_dir, shards=args.shards).serve_forever()
+            shard_dir=args.shard_dir, shards=args.shards,
+            table_server=args.table_server,
+            host_id=args.host_id).serve_forever()
 
 
 if __name__ == "__main__":

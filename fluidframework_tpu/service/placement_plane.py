@@ -210,15 +210,19 @@ class EpochTable:
         return rec["epoch"]
 
     @holds_lock("epoch_table_flock")
-    def record_core(self, owner: str, addr: str) -> None:
+    def record_core(self, owner: str, addr: str,
+                    host: Optional[str] = None) -> None:
         """Register ``owner@addr`` as a member (ShardHost calls this once
         per poll — cheap no-op when the row already matches). Membership
         is a capacity advertisement, not a route: nothing fences on it,
         so it does NOT bump the epoch. An existing draining/drained mark
         survives re-registration — the drain decision outlives the
-        core's own heartbeat."""
+        core's own heartbeat. ``host`` is the member's host-group id
+        (multi-host fleets): the rebalancer's locality tiebreak and the
+        gateways' same-host accounting read it back from the row."""
         row = self.cores().get(owner)
-        if row is not None and row["addr"] == addr:
+        if row is not None and row["addr"] == addr \
+                and row.get("host") == host:
             return
         with _flock(self._lock_path):
             rec = self._read_fresh()
@@ -227,6 +231,8 @@ class EpochTable:
             cores[owner] = {
                 "addr": addr,
                 "state": prev["state"] if prev else CORE_ACTIVE}
+            if host is not None:
+                cores[owner]["host"] = host
             self._write(rec)
 
     @holds_lock("epoch_table_flock")
@@ -372,6 +378,7 @@ class MigrationEngine:
                          else tier_counters("placement"))
         self.journal = journal if journal is not None else get_journal()
         self._adopt_cause: Optional[str] = None
+        self._adopt_log_blob: Optional[str] = None
 
     # -------------------------------------------------------------- source
 
@@ -429,9 +436,15 @@ class MigrationEngine:
             host.hb_times.pop(k, None)
             host.servers.pop(k, None)
             server.revoke()
+            # cross-host handoff: the flushed log dir lives in THIS host
+            # group's disjoint working dir, so ship it through the shared
+            # storage tier — the target then resumes from exactly the
+            # checkpoint + idempotent tail a shared filesystem would give
+            log_blob = self._ship_log(k, target_addr, cause=ckpt_id)
             # 4. handoff: the target transfers the lease + claims the epoch
             do_adopt = adopt if adopt is not None else self._rpc_adopt
             self._adopt_cause = ckpt_id
+            self._adopt_log_blob = log_blob
             try:
                 result = do_adopt(k, target_addr)
             except Exception as exc:
@@ -441,6 +454,7 @@ class MigrationEngine:
                 raise
             finally:
                 self._adopt_cause = None
+                self._adopt_log_blob = None
             if self.fault_plane is not None:
                 # the "source dies during target replay" window: the
                 # target owns the lease + epoch; the source merely fails
@@ -470,6 +484,74 @@ class MigrationEngine:
             host.servers[k] = host._make_server(k)
             host.hb_times[k] = time.monotonic()
 
+    def _host_of_addr(self, addr: str) -> Optional[str]:
+        """The host-group id advertising ``addr`` in the table's cores
+        section, or None (single-host fleet / unregistered core)."""
+        for row in self.host.table.cores().values():
+            if row.get("addr") == addr:
+                return row.get("host")
+        return None
+
+    @loop_only("core")
+    def _ship_log(self, k: int, target_addr: str,
+                  cause: Optional[str] = None) -> Optional[str]:
+        """When the target core lives in ANOTHER host group, upload the
+        (sealed, flushed) ``log-<k>`` dir to the shared storage tier as
+        one blob and return its id for the adopt frame. Same-host and
+        single-host moves return None — the shared dir IS the transport
+        there, exactly as before."""
+        my_host = getattr(self.host, "host_id", None)
+        if my_host is None:
+            return None
+        dst_host = self._host_of_addr(target_addr)
+        if dst_host is None or dst_host == my_host:
+            return None
+        storage = getattr(self.host, "storage_server", None)
+        if storage is None:
+            raise RuntimeError(
+                f"cross-host migration of partition {k} needs a storage "
+                "tier to ship the durable log through")
+        import io
+        import tarfile
+
+        log_dir = os.path.join(self.host.shard_dir, f"log-{k}")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            tf.add(log_dir, arcname=".")
+        s_host, s_port = storage
+        reply = admin_rpc(s_host, int(s_port),
+                          {"t": "write_blob", "hex": buf.getvalue().hex()})
+        self.journal.emit("migration.ship", cause=cause, part=k,
+                          src_host=my_host, dst_host=dst_host,
+                          blob=reply["id"], bytes=buf.getbuffer().nbytes)
+        return reply["id"]
+
+    def _fetch_log(self, k: int, log_blob: str) -> None:
+        """Target side of the ship: replace the local ``log-<k>`` dir
+        with the shipped one (any content there is a dead generation —
+        the partition's live log just arrived)."""
+        import io
+        import shutil
+        import tarfile
+
+        storage = getattr(self.host, "storage_server", None)
+        if storage is None:
+            raise RuntimeError(
+                f"adopting partition {k} with a shipped log needs a "
+                "storage tier")
+        s_host, s_port = storage
+        reply = admin_rpc(s_host, int(s_port),
+                          {"t": "read_blob", "id": log_blob})
+        log_dir = os.path.join(self.host.shard_dir, f"log-{k}")
+        shutil.rmtree(log_dir, ignore_errors=True)
+        os.makedirs(log_dir, exist_ok=True)
+        buf = io.BytesIO(bytes.fromhex(reply["hex"]))
+        with tarfile.open(fileobj=buf, mode="r:gz") as tf:
+            try:
+                tf.extractall(log_dir, filter="data")
+            except TypeError:  # filter= needs py3.12; our own archive
+                tf.extractall(log_dir)  # noqa: S202
+
     @loop_only("core")
     def _rpc_adopt(self, k: int, target_addr: str) -> dict:
         """Default target-side handoff: one blocking admin RPC against the
@@ -482,6 +564,8 @@ class MigrationEngine:
             # links its adopt entry back to the source's checkpoint —
             # the fleet merge stitches the chain across processes
             frame["journal_cause"] = self._adopt_cause
+        if self._adopt_log_blob:
+            frame["log_blob"] = self._adopt_log_blob
         secret = getattr(self.host, "admin_secret", None)
         if secret:
             frame["secret"] = secret
@@ -490,13 +574,25 @@ class MigrationEngine:
     # -------------------------------------------------------------- target
 
     @loop_only("core")
-    def adopt(self, k: int, from_owner: str,
-              cause: Optional[str] = None) -> dict:
+    def adopt(self, k: int, from_owner: str, cause: Optional[str] = None,
+              log_blob: Optional[str] = None) -> dict:
         """Target side: take over ``k`` from ``from_owner`` and resume its
-        pipeline from the shipped checkpoint + idempotent raw-log tail."""
+        pipeline from the shipped checkpoint + idempotent raw-log tail.
+        ``log_blob`` (cross-host moves) names the storage-tier blob
+        carrying the source's sealed log dir; it is materialized BEFORE
+        the lease transfer so a fetch failure aborts the handoff while
+        the source can still reclaim."""
         host = self.host
+        if log_blob:
+            self._fetch_log(k, log_blob)
         if not host.placement.transfer(k, from_owner, host.owner_id,
                                        host.address):
+            if log_blob:
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(host.shard_dir, f"log-{k}"),
+                    ignore_errors=True)
             raise RuntimeError(
                 f"partition {k} not transferable from {from_owner}")
         adopt_id = self.journal.emit("migration.adopt", cause=cause,

@@ -130,7 +130,15 @@ def plan_rebalance(heat: dict, owners: dict, cores: dict,
     partition only when the hottest→coldest gap exceeds
     ``improvement × mean`` (halved under ``slo_hot``), the candidate
     strictly narrows that gap, and its dwell clock has expired.
+
+    Locality tiebreak (multi-host fleets): cores rows may carry a
+    ``host`` group id; among equally-loaded targets the planner prefers
+    one in the SOURCE's host group, so a cross-host hop (and its
+    log-shipping handoff) is paid only when load demands it. Single-host
+    fleets have no ``host`` keys — every target ties on locality and
+    the historical pick order is unchanged.
     """
+    hostmap = {o: row.get("host") for o, row in cores.items()}
     active = sorted(o for o, row in cores.items()
                     if row.get("state", CORE_ACTIVE) == CORE_ACTIVE)
     draining = sorted(o for o, row in cores.items()
@@ -162,7 +170,10 @@ def plan_rebalance(heat: dict, owners: dict, cores: dict,
             targets = [o for o in active if o != src]
             if not targets:
                 continue
-            dst = min(targets, key=lambda o: (loads[o], o))
+            dst = min(targets, key=lambda o: (
+                loads[o],
+                0 if hostmap.get(o) == hostmap.get(src) else 1,
+                o))
             if state in (CORE_DRAINING, CORE_DRAINED):
                 if not parts:
                     continue
@@ -243,34 +254,72 @@ def read_local_heat(parts: Iterable[int], now: Optional[float] = None,
     return out
 
 
+@blocking("fleet-wide heat fan-out: concurrent per-peer dials joined "
+          "on ONE shared deadline — runs on the rebalancer ticker")
 def collect_fleet_heat(table_rec: dict, self_owner: str,
                        self_heat: dict, secret: Optional[str] = None,
                        timeout: float = 5.0) -> tuple:
     """Fan ``admin_core_heat`` across the membership and merge with the
     local read. Returns ``(heat, reachable)``; a peer whose dial fails
     is left OUT of ``reachable``, so the planner never targets a core
-    that cannot answer a one-frame RPC."""
+    that cannot answer a one-frame RPC.
+
+    Dials run CONCURRENTLY (one daemon thread each) against a shared
+    deadline: one wedged peer costs the scrape ``timeout`` seconds
+    total, not ``timeout × peers`` — with 16 cores a single dead host
+    group used to stall the tick for over a minute. A dial still
+    in flight at the deadline is counted
+    (``placement.heat.scrape_timeouts``) and its owner treated exactly
+    like a refused dial: out of ``reachable``, never a target."""
     heat = dict(self_heat)
     reachable = {self_owner}
+    dials = []
     for owner, row in sorted(table_rec.get("cores", {}).items()):
         if owner == self_owner:
             continue
         if row.get("state") == CORE_DRAINED:
             reachable.add(owner)  # owns nothing; no dial needed
             continue
+        dials.append((owner, row))
+    if not dials:
+        return heat, reachable
+    replies: dict = {}
+
+    def dial(owner: str, row: dict) -> None:
         host_s, _, port_s = row.get("addr", "").rpartition(":")
         frame = {"t": "admin_core_heat"}
         if secret:
             frame["secret"] = secret
         try:
-            reply = admin_rpc(host_s or "127.0.0.1", int(port_s),
-                              frame, timeout=timeout)
+            replies[owner] = admin_rpc(host_s or "127.0.0.1",
+                                       int(port_s), frame,
+                                       timeout=timeout)
         except (OSError, ValueError, RuntimeError):
+            pass  # unreachable: absent from replies
+
+    threads = [threading.Thread(target=dial, args=d, daemon=True,
+                                name=f"heat-scrape-{d[0]}")
+               for d in dials]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+    timeouts = 0
+    for (owner, _row), t in zip(dials, threads):
+        if t.is_alive():
+            timeouts += 1  # abandoned: the daemon thread dies unheard
+            continue
+        reply = replies.get(owner)
+        if reply is None:
             continue
         reachable.add(owner)
         for ks, h in reply.get("parts", {}).items():
             heat[int(ks)] = PartHeat(ops=float(h.get("ops", 0.0)),
                                      bytes=float(h.get("bytes", 0.0)))
+    if timeouts:
+        placement_counters().inc("placement.heat.scrape_timeouts",
+                                 timeouts)
     return heat, reachable
 
 

@@ -184,9 +184,14 @@ class StorageService:
 
 class StorageServer:
     def __init__(self, directory: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, table_door=None):
         self.service = StorageService(directory)
         self.host, self.port = host, port
+        # placement table door (service/table_client.TableDoorService):
+        # when set, ``admin_table_*`` frames are served on THIS socket —
+        # the placement host's flock keeps serializing every table
+        # write, remote host groups just reach it over the wire
+        self.table_door = table_door
 
     async def _handle_conn(self, reader, writer) -> None:
         try:
@@ -197,7 +202,11 @@ class StorageServer:
                 frame = json.loads(body.decode())
                 rid = frame.get("rid")
                 try:
-                    reply = self.service.handle(frame)
+                    if self.table_door is not None and str(
+                            frame.get("t", "")).startswith("admin_table_"):
+                        reply = self.table_door.handle(frame)
+                    else:
+                        reply = self.service.handle(frame)
                 except Exception as e:  # noqa: BLE001 — reply, don't die
                     reply = {"t": "error", "message": str(e)}
                 reply["rid"] = rid
@@ -230,8 +239,25 @@ def main() -> None:
     p.add_argument("--dir", required=True)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--table-dir", default=None,
+                   help="serve the placement table door (admin_table_*) "
+                        "over this shard dir's flocked lease/epoch files")
+    p.add_argument("--shards", type=int, default=0,
+                   help="partition count for the table door")
+    p.add_argument("--lease-ttl", type=float, default=None,
+                   help="lease TTL for the table door's PlacementDir")
     args = p.parse_args()
-    StorageServer(args.dir, host=args.host, port=args.port).serve_forever()
+    door = None
+    if args.table_dir:
+        from .placement import DEFAULT_TTL_S
+        from .table_client import TableDoorService
+
+        door = TableDoorService(
+            args.table_dir, args.shards,
+            ttl_s=(args.lease_ttl if args.lease_ttl is not None
+                   else DEFAULT_TTL_S))
+    StorageServer(args.dir, host=args.host, port=args.port,
+                  table_door=door).serve_forever()
 
 
 if __name__ == "__main__":

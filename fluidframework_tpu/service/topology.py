@@ -26,10 +26,12 @@ the cold-start chaos drill are its two callers.
 
 Counters (tier "frontend", locked in fluidlint's registry):
 
-    topology.fleet.starts     fleets started from a spec
-    topology.fleet.restarts   fleets RE-started from the same spec
-    topology.fleet.kills      whole-fleet kill -9s issued
-    topology.core.spawns      cores constructed via build_core
+    topology.fleet.starts       fleets started from a spec
+    topology.fleet.restarts     fleets RE-started from the same spec
+    topology.fleet.kills        whole-fleet kill -9s issued
+    topology.fleet.host_kills   single host-group kill -9s (kill_host)
+    topology.fleet.host_starts  single host-group respawns (start_host)
+    topology.core.spawns        cores constructed via build_core
 """
 
 from __future__ import annotations
@@ -53,6 +55,9 @@ class CoreSpec:
     name: str
     prefer: list = dataclasses.field(default_factory=list)
     port: int = 0
+    # multi-host fleets: which host group (TopologySpec.hosts key) runs
+    # this core; None = the placement host
+    host: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -65,6 +70,8 @@ class GatewaySpec:
     name: str
     port: int = 0
     upstream: Optional[int] = None
+    # multi-host fleets: which host group runs this gateway
+    host: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -90,6 +97,27 @@ class TopologySpec:
     boot_burst: int = 32
     # self-driving placement: kwargs for enable_rebalancer, or None
     rebalance: Optional[dict] = None
+    # ---- multi-host fleets ----------------------------------------
+    # host groups: {host_id: address}. Empty = classic single-host.
+    # Each non-placement group runs in a DISJOINT working dir
+    # (``host_dir``) with its own process group — no flock, no file
+    # sharing with the placement host; its cores reach the lease/epoch
+    # plane only through the table door (``table_server``).
+    hosts: dict = dataclasses.field(default_factory=dict)
+    # which host group owns the shard dir, the storage tier, and the
+    # table door; None defaults to the lexicographically first host id
+    placement_host: Optional[str] = None
+    # "host:port" of the admin_table_* door — resolved by the Fleet
+    # once the storage process binds (the door rides its socket)
+    table_server: Optional[str] = None
+    # ShardHost claim policy: None/"any" (historical — claim stale
+    # leases anywhere) or "prefer" (pinned: multi-host fleets without
+    # log replication can't resume a foreign group's log by takeover)
+    claim_policy: Optional[str] = None
+    # forward-compat (rolling upgrade): top-level keys this build does
+    # not know survive load→save round-trips via this bag — a newer
+    # spec rewritten by an older core keeps the newer fields
+    extras: dict = dataclasses.field(default_factory=dict)
 
     # ---- JSON round-trip ------------------------------------------
 
@@ -99,14 +127,19 @@ class TopologySpec:
                       else c for c in self.cores]
         d["gateways"] = [dataclasses.asdict(g) if not isinstance(g, dict)
                          else g for g in self.gateways]
-        return d
+        # unknown-key passthrough: flatten the bag back to the top
+        # level (known fields win on collision — ours are typed)
+        extras = d.pop("extras", None) or {}
+        return {**extras, **d}
 
     @classmethod
     def from_dict(cls, d: dict) -> "TopologySpec":
-        d = dict(d)
+        known = {f.name for f in dataclasses.fields(cls)} - {"extras"}
+        extras = {k: v for k, v in d.items() if k not in known}
+        d = {k: v for k, v in d.items() if k in known}
         d["cores"] = [CoreSpec(**c) for c in d.get("cores", [])]
         d["gateways"] = [GatewaySpec(**g) for g in d.get("gateways", [])]
-        return cls(**d)
+        return cls(**d, extras=extras)
 
     def save(self, path: str) -> str:
         tmp = path + ".tmp"
@@ -128,8 +161,66 @@ class TopologySpec:
         host, _, port = self.storage_server.rpartition(":")
         return (host or "127.0.0.1", int(port))
 
+    def table_addr(self) -> Optional[tuple]:
+        if not self.table_server:
+            return None
+        host, _, port = self.table_server.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
     def core_name(self, i: int) -> str:
         return self.cores[i].name or f"core{i}"
+
+    # ---- host groups ----------------------------------------------
+
+    def placement_host_id(self) -> Optional[str]:
+        """The host group owning the shard dir / storage / table door
+        (None for classic single-host specs)."""
+        if self.placement_host:
+            return self.placement_host
+        return min(self.hosts) if self.hosts else None
+
+    def host_addr(self, hid: Optional[str]) -> str:
+        """A host group's network address (``spec.host`` for None or
+        unknown ids — the single-host fallback)."""
+        if hid is None:
+            return self.host
+        return self.hosts.get(hid, self.host)
+
+    def host_is_remote(self, hid: Optional[str]) -> bool:
+        return bool(self.hosts) and hid is not None \
+            and hid != self.placement_host_id()
+
+    def host_dir(self, hid: Optional[str]) -> str:
+        """The group's working dir: the shard dir for the placement
+        host, a DISJOINT sibling for every other group — remote cores
+        never open (or flock) a placement-host path; simulated machines
+        on one box share nothing but sockets."""
+        if not self.host_is_remote(hid):
+            return self.shard_dir
+        return f"{self.shard_dir.rstrip(os.sep)}-host-{hid}"
+
+    def host_spec_path(self, hid: Optional[str]) -> str:
+        return os.path.join(self.host_dir(hid), "topology.json")
+
+    def core_host(self, i: int) -> Optional[str]:
+        """Which host group core ``i`` runs in (None single-host)."""
+        if not self.hosts:
+            return None
+        return self.cores[i].host or self.placement_host_id()
+
+    def core_is_remote(self, i: int) -> bool:
+        return self.host_is_remote(self.core_host(i))
+
+    def core_dir(self, i: int) -> str:
+        return self.host_dir(self.core_host(i))
+
+    def core_host_addr(self, i: int) -> str:
+        return self.host_addr(self.core_host(i))
+
+    def gateway_host(self, i: int) -> Optional[str]:
+        if not self.hosts:
+            return None
+        return self.gateways[i].host or self.placement_host_id()
 
     def spec_path(self) -> str:
         """Canonical on-disk home: the spec lives beside the state it
@@ -145,14 +236,27 @@ class TopologySpec:
                      gateway_ports: dict,
                      python: str = sys.executable) -> list:
         g = self.gateways[i]
+        ghid = self.gateway_host(i)
         argv = [python, "-m", "fluidframework_tpu.service.gateway",
-                "--host", self.host, "--port", str(g.port)]
+                "--host", self.host_addr(ghid), "--port", str(g.port)]
         if g.upstream is not None:
             up = gateway_ports[g.upstream]
-            argv += ["--upstream-gateway", f"{self.host}:{up}"]
+            up_addr = self.host_addr(self.gateway_host(g.upstream))
+            argv += ["--upstream-gateway", f"{up_addr}:{up}"]
+        elif self.host_is_remote(ghid):
+            # remote host group: route from the table door over the
+            # wire — this gateway has no placement dir to read
+            if not self.table_server:
+                raise RuntimeError(
+                    f"gateway {g.name} is in remote host group "
+                    f"{ghid!r} but the spec has no table_server")
+            argv += ["--table-server", self.table_server,
+                     "--shards", str(self.n_partitions)]
         else:
             argv += ["--shard-dir", self.shard_dir,
                      "--shards", str(self.n_partitions)]
+        if ghid is not None and g.upstream is None:
+            argv += ["--host-id", ghid]
         return argv
 
 
@@ -172,10 +276,33 @@ def build_core(spec: TopologySpec, core_index: int, *,
     from .rehydrate import boot_counters
 
     core = spec.cores[core_index]
-    host = ShardHost(spec.shard_dir, spec.n_partitions,
+    core_dir = spec.core_dir(core_index)
+    table_client = None
+    if spec.core_is_remote(core_index):
+        # remote host group: the lease/epoch plane is the placement
+        # host's table door, reached over the wire — this process
+        # neither sees nor flocks any placement-host file
+        from .placement import DEFAULT_TTL_S
+        from .table_client import RemoteTableClient
+
+        taddr = spec.table_addr()
+        if taddr is None:
+            raise RuntimeError(
+                f"core {spec.core_name(core_index)} is in remote host "
+                f"group {spec.core_host(core_index)!r} but the spec "
+                "has no table_server (start the fleet's storage "
+                "process with --table-dir first)")
+        table_client = RemoteTableClient(
+            f"{taddr[0]}:{taddr[1]}", spec.n_partitions,
+            ttl_s=(spec.lease_ttl if spec.lease_ttl is not None
+                   else DEFAULT_TTL_S))
+    host = ShardHost(core_dir, spec.n_partitions,
                      prefer=core.prefer,
                      storage_server=spec.storage_addr(),
-                     ttl_s=spec.lease_ttl)
+                     ttl_s=spec.lease_ttl,
+                     table_client=table_client,
+                     host_id=spec.core_host(core_index),
+                     claim_policy=spec.claim_policy)
     if arm_journal:
         from ..obs import arm_journal as _arm
 
@@ -184,7 +311,7 @@ def build_core(spec: TopologySpec, core_index: int, *,
         # their (fresh) owner id — unique but not restart-stable
         name = spec.cores[core_index].name or host.owner_id
         table = host.table
-        jr = _arm(os.path.join(spec.shard_dir, "journal",
+        jr = _arm(os.path.join(core_dir, "journal",
                                f"{name}.jsonl"),
                   core=name,
                   epoch_fn=lambda: table.read().get("epoch", 0))
@@ -194,7 +321,7 @@ def build_core(spec: TopologySpec, core_index: int, *,
             owner=host.owner_id, shards=spec.n_partitions,
             prefer=list(core.prefer))
     front = NetworkFrontEnd(
-        host=spec.host,
+        host=spec.core_host_addr(core_index),
         port=core.port if port is None else port,
         shard_host=host, admin_secret=spec.admin_secret)
     if spec.summarize_every is not None:
@@ -220,6 +347,37 @@ def default_spec(shard_dir: str, n_cores: int, n_partitions: int,
     kw.setdefault("storage_dir", os.path.join(shard_dir, "storage"))
     return TopologySpec(shard_dir=shard_dir, n_partitions=n_partitions,
                         cores=cores, **kw)
+
+
+def multihost_spec(shard_dir: str, n_hosts: int, cores_per_host: int,
+                   n_partitions: int, gateway_per_host: bool = True,
+                   **kw) -> TopologySpec:
+    """The common multi-host shape: ``n_hosts`` simulated host groups
+    (``h0`` is the placement host — shard dir, storage tier, table
+    door), ``cores_per_host`` cores each with partitions dealt
+    round-robin across ALL cores, one shard-aware gateway per host, and
+    ``claim_policy="prefer"`` (partitions are pinned — without log
+    replication a foreign group's log cannot be resumed by takeover;
+    cross-host MIGRATION ships the log through storage instead)."""
+    n_cores = n_hosts * cores_per_host
+    cores = []
+    for i in range(n_cores):
+        cores.append(CoreSpec(
+            name=f"core{i}",
+            prefer=[k for k in range(n_partitions)
+                    if k % n_cores == i],
+            host=f"h{i // cores_per_host}"))
+    gateways = []
+    if gateway_per_host:
+        gateways = [GatewaySpec(name=f"gw-h{h}", host=f"h{h}")
+                    for h in range(n_hosts)]
+    kw.setdefault("storage_dir", os.path.join(shard_dir, "storage"))
+    kw.setdefault("claim_policy", "prefer")
+    return TopologySpec(
+        shard_dir=shard_dir, n_partitions=n_partitions, cores=cores,
+        gateways=gateways,
+        hosts={f"h{h}": "127.0.0.1" for h in range(n_hosts)},
+        placement_host="h0", **kw)
 
 
 class Fleet:
@@ -256,6 +414,9 @@ class Fleet:
         self.storage_proc = None   # subprocess mode
         self.storage_runner = None  # in-proc mode
         self._generation = 0
+        # multi-host: host group id → that group's live Popens (cores +
+        # gateways), the unit kill_host()/start_host() operate on
+        self.host_procs: dict = {}
 
     # ---- lifecycle ------------------------------------------------
 
@@ -284,13 +445,28 @@ class Fleet:
         return self
 
     def _start_inproc(self) -> None:
-        if self.spec.storage_dir:
-            self.storage_runner = _StorageRunner(self.spec.storage_dir,
-                                                 self.spec.host)
+        spec = self.spec
+        if spec.storage_dir:
+            door = None
+            if spec.hosts:
+                from .placement import DEFAULT_TTL_S
+                from .table_client import TableDoorService
+
+                door = TableDoorService(
+                    spec.shard_dir, spec.n_partitions,
+                    ttl_s=(spec.lease_ttl if spec.lease_ttl is not None
+                           else DEFAULT_TTL_S))
+            placement_addr = spec.host_addr(spec.placement_host_id())
+            self.storage_runner = _StorageRunner(
+                spec.storage_dir, placement_addr, table_door=door)
             port = self.storage_runner.start()
-            self.spec.storage_server = f"{self.spec.host}:{port}"
-        for i in range(len(self.spec.cores)):
-            front = build_core(self.spec, i, arm_journal=False)
+            spec.storage_server = f"{placement_addr}:{port}"
+            if door is not None:
+                spec.table_server = spec.storage_server
+        for hid in spec.hosts:
+            os.makedirs(spec.host_dir(hid), exist_ok=True)
+        for i in range(len(spec.cores)):
+            front = build_core(spec, i, arm_journal=False)
             front.start_background()
             self.fronts[i] = front
             self.core_ports[i] = front.port
@@ -301,41 +477,76 @@ class Fleet:
         env = dict(os.environ)
         if self.env:
             env.update(self.env)
-        if self.spec.storage_dir:
+        self._env_cache = env
+        spec = self.spec
+        if spec.storage_dir:
+            placement_addr = spec.host_addr(spec.placement_host_id())
+            argv = [sys.executable, "-m",
+                    "fluidframework_tpu.service.storage_server",
+                    "--dir", spec.storage_dir,
+                    "--host", placement_addr]
+            if spec.hosts:
+                # the table door rides the storage socket: one extra
+                # frame family, zero extra processes
+                argv += ["--table-dir", spec.shard_dir,
+                         "--shards", str(spec.n_partitions)]
+                if spec.lease_ttl is not None:
+                    argv += ["--lease-ttl", str(spec.lease_ttl)]
             self.storage_proc = subprocess.Popen(
-                [sys.executable, "-m",
-                 "fluidframework_tpu.service.storage_server",
-                 "--dir", self.spec.storage_dir,
-                 "--host", self.spec.host],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, env=env)
             port = _read_listening(self.storage_proc, "storage")
-            self.spec.storage_server = f"{self.spec.host}:{port}"
+            spec.storage_server = f"{placement_addr}:{port}"
+            if spec.hosts:
+                spec.table_server = spec.storage_server
         # saved AFTER the storage tier binds: the spec file each core
-        # loads carries the resolved storage address
-        spec_path = self.spec.save(self.spec.spec_path())
-        for i in range(len(self.spec.cores)):
-            argv = self.spec.core_argv(i, spec_path)
-            self.procs[i] = subprocess.Popen(
-                argv, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True, env=env)
+        # loads carries the resolved storage + table-door addresses
+        spec.save(spec.spec_path())
+        # each remote host group gets a COPY of the spec in its own
+        # disjoint dir — its processes never read a placement-host path
+        for hid in spec.hosts:
+            if spec.host_is_remote(hid):
+                os.makedirs(spec.host_dir(hid), exist_ok=True)
+                spec.save(spec.host_spec_path(hid))
+        for i in range(len(spec.cores)):
+            self._spawn_core(i, env)
         for i, p in self.procs.items():
-            self.core_ports[i] = _read_listening(p, self.spec.core_name(i))
+            self.core_ports[i] = _read_listening(p, spec.core_name(i))
         # gateways after cores: a shard-aware gateway routes from the
         # epoch table the cores have begun writing; relay tiers after
         # their upstream so the splice target exists
-        order = [i for i, g in enumerate(self.spec.gateways)
+        order = [i for i, g in enumerate(spec.gateways)
                  if g.upstream is None]
-        order += [i for i, g in enumerate(self.spec.gateways)
+        order += [i for i, g in enumerate(spec.gateways)
                   if g.upstream is not None]
         for i in order:
-            argv = self.spec.gateway_argv(i, self.core_ports,
-                                          self.gw_ports)
-            self.gw_procs[i] = subprocess.Popen(
-                argv, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True, env=env)
+            self._spawn_gateway(i, env)
             self.gw_ports[i] = _read_listening(
-                self.gw_procs[i], self.spec.gateways[i].name)
+                self.gw_procs[i], spec.gateways[i].name)
+
+    def _spawn_core(self, i: int, env: dict) -> None:
+        spec = self.spec
+        hid = spec.core_host(i)
+        spec_path = (spec.host_spec_path(hid) if spec.host_is_remote(hid)
+                     else spec.spec_path())
+        p = subprocess.Popen(
+            spec.core_argv(i, spec_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+            start_new_session=bool(spec.hosts))
+        self.procs[i] = p
+        if hid is not None:
+            self.host_procs.setdefault(hid, []).append(p)
+
+    def _spawn_gateway(self, i: int, env: dict) -> None:
+        spec = self.spec
+        p = subprocess.Popen(
+            spec.gateway_argv(i, self.core_ports, self.gw_ports),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, start_new_session=bool(spec.hosts))
+        self.gw_procs[i] = p
+        hid = spec.gateway_host(i)
+        if hid is not None:
+            self.host_procs.setdefault(hid, []).append(p)
 
     def kill(self) -> "Fleet":
         """kill -9 the whole fleet: no checkpoint, no close, no
@@ -370,8 +581,72 @@ class Fleet:
         self.fronts.clear()
         self.core_ports.clear()
         self.gw_ports.clear()
+        self.host_procs.clear()
         self.storage_proc = None
         self.storage_runner = None
+        return self
+
+    def kill_host(self, hid: str) -> "Fleet":
+        """kill -9 ONE host group (its separate process group simulates
+        a machine dying): every core and gateway of ``hid``, nothing
+        else. The placement host's storage/table door stays up unless
+        ``hid`` IS the placement host."""
+        from .rehydrate import boot_counters
+
+        boot_counters().inc("topology.fleet.host_kills")
+        victims = list(self.host_procs.pop(hid, []))
+        if (hid == self.spec.placement_host_id()
+                and self.storage_proc is not None):
+            victims.append(self.storage_proc)
+            self.storage_proc = None
+        for p in victims:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+        for p in victims:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        dead = set(victims)
+        for i in [i for i, p in self.procs.items() if p in dead]:
+            self.procs.pop(i)
+            self.core_ports.pop(i, None)
+        for i in [i for i, p in self.gw_procs.items() if p in dead]:
+            self.gw_procs.pop(i)
+            self.gw_ports.pop(i, None)
+        return self
+
+    def start_host(self, hid: str) -> "Fleet":
+        """Respawn ONE host group from its spec copy — the recovery
+        half of :meth:`kill_host` (subprocess mode only)."""
+        from .rehydrate import boot_counters
+
+        boot_counters().inc("topology.fleet.host_starts")
+        env = getattr(self, "_env_cache", None)
+        if env is None:
+            env = dict(os.environ)
+            if self.env:
+                env.update(self.env)
+        spec = self.spec
+        mine = [i for i in range(len(spec.cores))
+                if spec.core_host(i) == hid]
+        for i in mine:
+            self._spawn_core(i, env)
+        for i in mine:
+            self.core_ports[i] = _read_listening(self.procs[i],
+                                                 spec.core_name(i))
+        gws = [i for i, g in enumerate(spec.gateways)
+               if spec.gateway_host(i) == hid]
+        for i in sorted(gws, key=lambda i:
+                        spec.gateways[i].upstream is not None):
+            self._spawn_gateway(i, env)
+            self.gw_ports[i] = _read_listening(
+                self.gw_procs[i], spec.gateways[i].name)
         return self
 
     def restart(self) -> "Fleet":
@@ -420,33 +695,44 @@ class Fleet:
     # ---- addressing -----------------------------------------------
 
     def core_addr(self, i: int) -> tuple:
-        return (self.spec.host, self.core_ports[i])
+        return (self.spec.core_host_addr(i), self.core_ports[i])
 
     def client_addr(self) -> tuple:
         """Where clients dial: the deepest gateway tier if one exists,
         else the first core."""
         if self.gw_ports:
             leaf = max(self.gw_ports)
-            return (self.spec.host, self.gw_ports[leaf])
+            return (self.spec.host_addr(self.spec.gateway_host(leaf)),
+                    self.gw_ports[leaf])
         return self.core_addr(0)
 
-    def wait_claimed(self, timeout: float = 30.0) -> None:
-        """Block until every partition is routed to one of THIS
-        generation's cores in the epoch table — 'the fleet is up'.
-        (After a restart the table still carries the dead generation's
-        rows, so mere presence of an owner proves nothing.)"""
+    def gateway_addr(self, i: int) -> tuple:
+        return (self.spec.host_addr(self.spec.gateway_host(i)),
+                self.gw_ports[i])
+
+    def wait_claimed(self, timeout: float = 30.0,
+                     parts: Optional[set] = None) -> None:
+        """Block until every partition (or just ``parts``) is routed to
+        one of THIS generation's cores in the epoch table — 'the fleet
+        is up'. (After a restart the table still carries the dead
+        generation's rows, so mere presence of an owner proves
+        nothing.)"""
         from .placement_plane import EpochTable
 
         table = EpochTable.for_shard_dir(self.spec.shard_dir)
-        want = {f"{self.spec.host}:{p}" for p in self.core_ports.values()}
+        want = {f"{self.spec.core_host_addr(i)}:{p}"
+                for i, p in self.core_ports.items()}
         floor = getattr(self, "_epoch_floor", 0)
+        need = (set(range(self.spec.n_partitions)) if parts is None
+                else {int(k) for k in parts})
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            parts = table.read().get("parts", {})
-            if (len(parts) >= self.spec.n_partitions
+            rows = table.read().get("parts", {})
+            have = {int(k): p for k, p in rows.items() if int(k) in need}
+            if (len(have) == len(need)
                     and all(p.get("addr") in want
                             and p.get("epoch", 0) > floor
-                            for p in parts.values())):
+                            for p in have.values())):
                 return
             time.sleep(0.05)
         raise TimeoutError(
@@ -458,10 +744,11 @@ class _StorageRunner:
     (it has no background mode of its own — subprocess deployments run
     it as a process)."""
 
-    def __init__(self, directory: str, host: str):
+    def __init__(self, directory: str, host: str, table_door=None):
         from .storage_server import StorageServer
 
-        self.srv = StorageServer(directory, host=host, port=0)
+        self.srv = StorageServer(directory, host=host, port=0,
+                                 table_door=table_door)
         self.loop = None
         self.thread = None
 

@@ -1,0 +1,306 @@
+"""Networked placement plane: local-vs-remote TableClient equivalence,
+the table door's zombie fence, epoch-gated snapshot coherence, and the
+multi-host topology spec's derived views (ISSUE 19).
+
+The load-bearing claim is that :class:`RemoteTableClient` changed the
+TRANSPORT, not the semantics: the same claim/heartbeat/release/transfer
+interleaving driven through the flock directly and through the table
+door must return identical booleans, identical epoch sequences, and an
+identical final table. The fuzz below asserts exactly that at seeds
+0/7/42.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.service.storage_server import StorageServer
+from fluidframework_tpu.service.table_client import (
+    LocalTableClient,
+    RemoteEpochTable,
+    RemoteTableClient,
+    TableDoorService,
+    TableFenceError,
+)
+from fluidframework_tpu.service.topology import TopologySpec, multihost_spec
+from fluidframework_tpu.utils.telemetry import Counters
+
+N_PARTS = 4
+OWNERS = ("a", "b", "c")
+
+
+def _start_door(tmp_path, shard_name, n=N_PARTS, ttl_s=30.0):
+    """A real table door on a real socket: TableDoorService riding a
+    StorageServer, exactly the production deployment shape."""
+    shard_dir = str(tmp_path / shard_name)
+    door = TableDoorService(shard_dir, n, ttl_s=ttl_s)
+    srv = StorageServer(str(tmp_path / f"{shard_name}-storage"), port=0,
+                        table_door=door)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 10.0
+    while srv.port == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.port, "door server did not bind"
+    return shard_dir, door, srv
+
+
+# ------------------------------------------------- equivalence fuzz
+
+
+@pytest.mark.parametrize("seed", [0, 7, 42])
+def test_local_remote_equivalence_fuzz(tmp_path, seed):
+    """The same randomized claim/heartbeat/transfer/release interleaving
+    through the local flock and through the door produces identical
+    results, identical epoch sequences, and identical final tables."""
+    local = LocalTableClient(str(tmp_path / "local"), N_PARTS,
+                             ttl_s=30.0, counters=Counters())
+    _, _, srv = _start_door(tmp_path, "remote")
+    remote = RemoteTableClient(f"127.0.0.1:{srv.port}", N_PARTS,
+                               ttl_s=30.0, counters=Counters())
+
+    rng = random.Random(seed)
+    trace_a: list = []
+    trace_b: list = []
+
+    # one shared op plan replayed against both worlds
+    ops = []
+    for _ in range(120):
+        k = rng.randrange(N_PARTS)
+        o = rng.choice(OWNERS)
+        ops.append((rng.choice(("claim", "heartbeat", "release",
+                                "transfer", "owner_of", "epoch")),
+                    k, o, rng.choice(OWNERS)))
+
+    def run(client, trace):
+        for op, k, o, o2 in ops:
+            addr = f"addr-{o}"
+            if op == "claim":
+                ok = client.leases.try_claim(k, o, addr)
+                trace.append(("claim", k, o, ok))
+                if ok:
+                    # what ShardHost.poll does after a claim lands
+                    trace.append(("epoch",
+                                  client.table.record_claim(k, o, addr)))
+            elif op == "heartbeat":
+                trace.append(("hb", k, o,
+                              client.leases.heartbeat(k, o)))
+            elif op == "release":
+                if client.leases.owner_of(k) == addr:
+                    client.leases.release(k, o)
+                    trace.append(("release", k, o,
+                                  client.table.record_release(k, o)))
+            elif op == "transfer":
+                ok = client.leases.transfer(k, o, o2, f"addr-{o2}")
+                trace.append(("transfer", k, o, o2, ok))
+                if ok:
+                    trace.append(("epoch", client.table.record_claim(
+                        k, o2, f"addr-{o2}")))
+            elif op == "owner_of":
+                trace.append(("owner_of", k, client.leases.owner_of(k)))
+            elif op == "epoch":
+                trace.append(("gepoch", client.table.global_epoch()))
+
+    run(local, trace_a)
+    run(remote, trace_b)
+
+    assert trace_a == trace_b
+
+    # final state: identical lease tables and epoch-table records
+    assert local.leases.table() == remote.leases.table()
+    remote.table._invalidate()  # bypass the snapshot for the final read
+    rec_a, rec_b = local.table.read(), remote.table.read()
+    assert rec_a["epoch"] == rec_b["epoch"]
+    assert rec_a["parts"] == rec_b["parts"]
+    remote.close()
+
+
+# ------------------------------------------------- the door's fence
+
+
+def test_zombie_ex_owner_fenced_via_remote_path(tmp_path):
+    """A remote zombie whose lease was taken over gets table_reject →
+    TableFenceError, counted as placement.table.stale_rejections — the
+    3-layer fencing proof carries to the networked path."""
+    _, _, srv = _start_door(tmp_path, "shard", ttl_s=0.6)
+    ca, cb = Counters(), Counters()
+    zombie = RemoteTableClient(f"127.0.0.1:{srv.port}", N_PARTS,
+                               ttl_s=0.6, counters=ca)
+    usurper = RemoteTableClient(f"127.0.0.1:{srv.port}", N_PARTS,
+                                ttl_s=0.6, counters=cb)
+
+    assert zombie.leases.try_claim(0, "a", "addr-a")
+    assert zombie.table.record_claim(0, "a", "addr-a") >= 1
+    time.sleep(0.9)  # lease expires; "a" never heartbeats again
+
+    assert usurper.leases.try_claim(0, "b", "addr-b")  # takeover
+    e2 = usurper.table.record_claim(0, "b", "addr-b")
+
+    with pytest.raises(TableFenceError):
+        zombie.table.record_claim(0, "a", "addr-a")
+    assert ca.snapshot().get("placement.table.stale_rejections") == 1
+    # the refused write bumped nothing and re-routed nothing
+    usurper.table._invalidate()
+    rec = usurper.table.read()
+    assert rec["epoch"] == e2
+    assert rec["parts"]["0"]["owner"] == "b"
+    zombie.close()
+    usurper.close()
+
+
+# ------------------------------------------------- snapshot coherence
+
+
+class _FakeChan:
+    """A scripted door: counts calls, serves a mutable record."""
+
+    def __init__(self):
+        self.rec = {"epoch": 1, "parts": {}, "cores": {}}
+        self.calls = 0
+
+    def call(self, frame):
+        assert frame["t"] == "admin_table_read"
+        self.calls += 1
+        return {"t": "table_rec", "rec": dict(self.rec)}
+
+
+def test_remote_snapshot_epoch_gated_coherence():
+    """Reads inside SNAP_TTL_S hit the snapshot; a note_epoch push for a
+    NEWER epoch drops it immediately (an old snapshot can never veto a
+    newer route); an older/equal push is ignored."""
+    chan, c = _FakeChan(), Counters()
+    table = RemoteEpochTable(chan, c)
+
+    assert table.global_epoch() == 1
+    assert table.global_epoch() == 1  # served from snapshot
+    assert chan.calls == 1
+    assert c.snapshot()["placement.table.cache_hits"] == 1
+
+    table.note_epoch(1)  # stale push: snapshot stays
+    assert table.global_epoch() == 1
+    assert chan.calls == 1
+
+    chan.rec["epoch"] = 5
+    table.note_epoch(5)  # coherence push: snapshot dead
+    assert table.global_epoch() == 5
+    assert chan.calls == 2
+    assert c.snapshot()["placement.table.rpc_reads"] == 2
+
+
+# ------------------------------------------------- topology spec
+
+
+def test_topology_unknown_keys_roundtrip_both_directions(tmp_path):
+    """Forward-compat: unknown top-level spec keys survive load→save
+    and save→load round trips untouched."""
+    d = {"shard_dir": str(tmp_path / "s"), "n_partitions": 4,
+         "cores": [{"name": "c0", "prefer": [0, 1, 2, 3]}],
+         "future_knob": {"x": 1}, "operator_note": "keep me"}
+    spec = TopologySpec.from_dict(d)
+    assert spec.extras == {"future_knob": {"x": 1},
+                           "operator_note": "keep me"}
+    out = spec.to_dict()
+    assert out["future_knob"] == {"x": 1}
+    assert out["operator_note"] == "keep me"
+    assert out["n_partitions"] == 4  # known fields still win
+
+    # and through the file: save → load → save preserves them
+    p = spec.save(str(tmp_path / "spec.json"))
+    spec2 = TopologySpec.load(p)
+    assert spec2.extras == spec.extras
+    assert spec2.to_dict()["future_knob"] == {"x": 1}
+
+
+def test_doctor_multihost_anomaly_trio(tmp_path):
+    """The doctor's multi-host triage: an unreachable host group (every
+    core a host id advertises failed capture), a cross-host epoch
+    regression (a later epoch.bump with a LOWER epoch for the same
+    part), and remote-table writes rejected by the door's fence."""
+    import json
+
+    from tools.doctor import diagnose
+
+    bundle = tmp_path / "bundle"
+    c0 = bundle / "cores" / "core0"
+    c0.mkdir(parents=True)
+    for owner in ("core2", "core3"):
+        (bundle / "cores" / owner).mkdir()
+    (bundle / "manifest.json").write_text(json.dumps({"cores": {
+        "core0": {"addr": "127.0.0.1:7000", "journal_armed": True},
+        "core2": {"addr": "10.0.0.2:7000",
+                  "error": "connection refused"},
+        "core3": {"addr": "10.0.0.2:7001", "error": "timed out"},
+    }}))
+    (bundle / "placement.json").write_text(json.dumps({
+        "parts": {"0": {"owner": "core0", "addr": "127.0.0.1:7000",
+                        "epoch": 5}},
+        "cores": {
+            "core0": {"addr": "127.0.0.1:7000", "state": "active",
+                      "host": "h0"},
+            "core2": {"addr": "10.0.0.2:7000", "state": "active",
+                      "host": "h1"},
+            "core3": {"addr": "10.0.0.2:7001", "state": "active",
+                      "host": "h1"},
+        }}))
+    (c0 / "scrape.prom").write_text(
+        "fluid_placement_table_stale_rejections 2\n")
+
+    def bump(seq, ts, core, epoch, part):
+        return {"id": f"{core}:{seq}", "seq": seq, "ts": ts,
+                "core": core, "epoch": epoch, "kind": "epoch.bump",
+                "cause": None, "labels": {"part": part,
+                                          "change": "claim"}}
+
+    (c0 / "journal.jsonl").write_text("\n".join(json.dumps(e) for e in [
+        bump(1, 100.0, "core0", 5, 0),
+        bump(2, 101.0, "core2", 3, 0),  # later wall-clock, LOWER epoch
+        bump(3, 102.0, "core0", 6, 1),  # other part: healthy
+    ]) + "\n")
+
+    rep = diagnose(str(bundle))
+    assert any("host group h1" in a and "unreachable" in a
+               for a in rep["anomalies"])
+    assert any("epoch regressed e3 on core2 after e5 on core0" in a
+               for a in rep["anomalies"])
+    assert any("2 remote-table write(s) rejected" in a
+               for a in rep["anomalies"])
+    # the healthy host group and part raise nothing extra: exactly the
+    # trio plus one capture-error row per dead core
+    assert not any("host group h0" in a for a in rep["anomalies"])
+    assert len(rep["anomalies"]) == 5
+
+
+def test_multihost_spec_derived_views(tmp_path):
+    """Host-group derivations: disjoint working dirs for remote groups,
+    same-dir for the placement host, remote leaf gateways wired to the
+    table door instead of the shard dir."""
+    shard = str(tmp_path / "fleet")
+    spec = multihost_spec(shard, n_hosts=2, cores_per_host=2,
+                          n_partitions=8)
+    spec.table_server = "127.0.0.1:9999"
+
+    assert spec.placement_host_id() == "h0"
+    assert spec.core_host(0) == "h0" and spec.core_host(3) == "h1"
+    assert spec.core_dir(0) == shard  # placement host: canonical dir
+    assert spec.core_dir(3) == f"{shard}-host-h1"  # remote: disjoint
+    assert spec.host_dir("h1") != spec.host_dir("h0")
+    assert spec.claim_policy == "prefer"
+
+    gw_ports: dict = {}
+    core_ports = {i: 7000 + i for i in range(4)}
+    for i, g in enumerate(spec.gateways):
+        argv = spec.gateway_argv(i, core_ports, gw_ports)
+        if spec.gateway_host(i) == "h1":
+            assert "--table-server" in argv and "--shard-dir" not in argv
+            assert "--host-id" in argv
+        else:
+            assert "--shard-dir" in argv
+
+    # remote group without a table door is a hard config error, not a
+    # silent fall-back onto the placement host's files
+    spec.table_server = None
+    bad = next(i for i in range(len(spec.gateways))
+               if spec.gateway_host(i) == "h1")
+    with pytest.raises(RuntimeError):
+        spec.gateway_argv(bad, core_ports, gw_ports)

@@ -28,10 +28,17 @@ The doctor joins these into a triage report:
    (``obs.trace.unknown_hops``), disarmed journals, journal write
    errors, cold-start regressions from ``boot.json`` (a doc that paid
    a whole-log replay, or parked boots idling against a refilled
-   admission bucket — the storm stalled), and static-contract
+   admission bucket — the storm stalled), static-contract
    violations in the capturing build (a dirty ``lint.json`` in
    production is an incident signal of its own — someone deployed past
-   the gate).
+   the gate), and the multi-host trio: an UNREACHABLE HOST GROUP
+   (every core a host id advertises failed capture — a machine down,
+   not a core restarting), a CROSS-HOST EPOCH REGRESSION (a later
+   ``epoch.bump`` with a lower epoch for the same partition — two
+   cores wrote the table through different planes), and remote-table
+   writes rejected by the door's fence
+   (``placement.table.stale_rejections`` in a scrape — a zombie
+   ex-owner kept writing after takeover).
 
 Read-only; exit 0 with "healthy" when nothing needs attention, exit 1
 when any anomaly or active SLO burn was found (so a CI gate can assert
@@ -176,6 +183,15 @@ def diagnose(bundle_dir: str) -> dict:
                 f"core {owner}: {int(unknown)} hop stamp(s) outside "
                 "this build's taxonomy (version-skewed client?) — "
                 "the breakdown is missing legs")
+        rejected = _scrape_counter(
+            scrape, "fluid_placement_table_stale_rejections")
+        if rejected:
+            anomalies.append(
+                f"core {owner}: {int(rejected)} remote-table write(s) "
+                "rejected by the door's fence — a zombie ex-owner kept "
+                "writing the epoch table after takeover (the fence held, "
+                "but that core's lease view is stale: check its host "
+                "group's clock and network)")
         journal = _load_journal(os.path.join(cdir, "journal.jsonl"))
         per_core_journals.append(journal)
         if row.get("journal_armed") is False and not journal:
@@ -239,6 +255,26 @@ def diagnose(bundle_dir: str) -> dict:
 
     merged = merge_entries(per_core_journals)
     report["journal_merged"] = merged
+    # cross-host epoch regression: replayed in WALL-CLOCK order, each
+    # partition's epoch.bump sequence must only move forward — a later
+    # bump with a lower epoch means two cores wrote the table through
+    # different planes (a host group split-brained past the fence)
+    last_bump: dict = {}
+    for e in sorted((e for e in merged if e.get("kind") == "epoch.bump"),
+                    key=lambda e: (e.get("ts", 0.0), e.get("epoch", 0))):
+        part = (e.get("labels") or {}).get("part")
+        epoch = e.get("epoch")
+        if part is None or epoch is None:
+            continue
+        prev = last_bump.get(part)
+        if prev is not None and epoch < prev[0]:
+            anomalies.append(
+                f"part {part}: epoch regressed e{epoch} on "
+                f"{e.get('core')} after e{prev[0]} on {prev[1]} — two "
+                "cores wrote the epoch table through different planes "
+                "(a remote group bypassing the table door?)")
+        if prev is None or epoch > prev[0]:
+            last_bump[part] = (epoch, e.get("core"))
     for e in merged:
         if e.get("kind") in ("migration.commit", "migration.fail"):
             report["migrations"].append(
@@ -268,6 +304,23 @@ def diagnose(bundle_dir: str) -> dict:
                 anomalies.append(
                     f"core {owner} is {state} but still owns parts "
                     f"{sorted(owned_by[owner])} — evacuation stuck?")
+        # unreachable host group: every core a host id advertises in the
+        # membership failed capture — that is a machine (or its network)
+        # down, not a core restarting; triage the host first
+        by_host: dict = {}
+        for owner, row in (placement.get("cores") or {}).items():
+            host = row.get("host")
+            if host is not None:
+                by_host.setdefault(host, []).append(owner)
+        for host, members in sorted(by_host.items()):
+            captured = [o for o in members if o in report["cores"]]
+            if captured and all(report["cores"][o].get("error")
+                                for o in captured):
+                anomalies.append(
+                    f"host group {host}: all {len(captured)} core(s) "
+                    f"({', '.join(sorted(captured))}) unreachable at "
+                    "capture — the whole host group is down or "
+                    "partitioned from the entry core")
     return report
 
 

@@ -45,23 +45,24 @@ WAIVERS: tuple = (
      "page-cache flush as the summary tick, once per poll"),
 
     ("BLOCKING-ON-LOOP",
-     "service.placement_plane._flock.<locals>.held",
-     "fcntl.flock",
-     "migration mutates the epoch table ON the loop BY DESIGN: "
-     "single-threadedness of the seal->fence->handoff window is the "
-     "no-two-writers proof, and the flock hold is a bounded local "
-     "file op"),
-
-    ("BLOCKING-ON-LOOP",
-     "service.placement.PlacementDir._lock.<locals>.held",
-     "fcntl.flock",
-     "lease claim/transfer under migration runs on the loop for the "
-     "same no-two-writers window; per-partition flock, bounded hold"),
-
-    ("BLOCKING-ON-LOOP",
      "service.placement_plane.MigrationEngine._rpc_adopt",
      "admin_rpc",
      "the handoff RPC blocks the loop BY DESIGN: nothing may be "
      "sequenced on this core while the target adopts the partition "
      "(deli's epoch fence covers the rest)"),
+
+    ("BLOCKING-ON-LOOP",
+     "service.placement_plane.MigrationEngine._ship_log",
+     "admin_rpc",
+     "the cross-host log upload rides the same sealed window as "
+     "_rpc_adopt: the partition is sealed + revoked, nothing may be "
+     "sequenced here until the target owns it, so the storage RPC's "
+     "synchrony IS the design"),
+
+    ("BLOCKING-ON-LOOP",
+     "service.placement_plane.MigrationEngine._fetch_log",
+     "admin_rpc",
+     "the target side of the ship: adopt replaces the log dir BEFORE "
+     "building the partition server, on the loop by design — serving "
+     "ops for a partition whose log is mid-replace would be the race"),
 )

@@ -118,9 +118,14 @@ LOCKED_FAMILIES = {
     # the topology spec / fleet launcher (service/topology.py): the
     # cold-storm bench and the coldstart chaos drill key on these to
     # prove restarts really went through the one declarative spec
+    # topology.fleet.host_kills / host_starts witness whole-host-group
+    # chaos (chaos/multihost.py kill -9's one host's process group and
+    # resurrects it through the same spec)
     "topology.": frozenset({"topology.fleet.starts",
                             "topology.fleet.restarts",
                             "topology.fleet.kills",
+                            "topology.fleet.host_kills",
+                            "topology.fleet.host_starts",
                             "topology.core.spawns"}),
     "storage.snapshot.": frozenset({"storage.snapshot.encodes",
                                     "storage.snapshot.cache_hits",
@@ -142,7 +147,13 @@ LOCKED_FAMILIES = {
     # names (service/placement_plane.py); placement.heat.* are the
     # rebalancer's windowed per-partition load series and
     # placement.rebalance.* count the self-driving loop's decisions —
-    # the storm bench's flap-free gate keys on them
+    # the storm bench's flap-free gate keys on them.
+    # placement.table.* are the networked table plane's client-side
+    # counters (service/table_client.py): the multi-host bench publishes
+    # cache_hits/rpc_reads as the coherence-protocol hit rate, and the
+    # doctor flags stale_rejections > 0 as a fenced zombie writer;
+    # heat.scrape_timeouts counts peers dropped from a fleet heat
+    # fan-out by the per-peer dial deadline (service/rebalancer.py)
     "placement.": frozenset({"placement.epoch.bumps",
                              "placement.epoch.stale_nacks",
                              "placement.cache.hits",
@@ -155,6 +166,11 @@ LOCKED_FAMILIES = {
                              "placement.migration.adopted",
                              "placement.heat.ops",
                              "placement.heat.bytes",
+                             "placement.heat.scrape_timeouts",
+                             "placement.table.rpc_reads",
+                             "placement.table.rpc_writes",
+                             "placement.table.cache_hits",
+                             "placement.table.stale_rejections",
                              "placement.rebalance.ticks",
                              "placement.rebalance.plans",
                              "placement.rebalance.migrations_issued",
@@ -167,10 +183,15 @@ LOCKED_FAMILIES = {
     # (service/gateway.py). NOTE: "fanout." does not collide with the
     # front end's "net.fanout.*" cache counters — prefixes match from
     # the name's start.
+    # fanout.upstream.same_host / cross_host split route resolutions by
+    # host locality (ISSUE 19): the multi-host bench's locality hit
+    # rate is same_host / (same_host + cross_host)
     "fanout.": frozenset({"fanout.relay.splices",
                           "fanout.relay.encodes",
                           "fanout.upstream.frames",
-                          "fanout.upstream.bytes"}),
+                          "fanout.upstream.bytes",
+                          "fanout.upstream.same_host",
+                          "fanout.upstream.cross_host"}),
     # the ephemeral presence lane: the soak's drop/dup rules prove loss
     # is invisible BECAUSE coalescing happens, which only these names
     # witness (service/presence.py)

@@ -49,7 +49,18 @@ amortization points of the socket tier (see ARCHITECTURE.md
   through the history door, read a historical seq through a read-only
   replay container, edit the fork and integrate the edit back into the
   parent — ``history.fork.boots``, ``history.replay.reads`` and
-  ``history.integrate.ops`` must all rise.
+  ``history.integrate.ops`` must all rise;
+- a 2-host-group fleet from one ``multihost_spec`` (subprocess, h1 in
+  a DISJOINT working dir on the remote table client) with a forced
+  CROSS-HOST migration under traffic: the sealed log must ship through
+  storage (``migration.ship`` in the fleet journal), every ack must
+  land exactly once, the remote core's ``placement.table.rpc_reads``
+  must be nonzero (its placement plane ran through the door), and an
+  ``admin bundle`` must triage clean through tools/doctor.py.
+
+``--only GATE`` (repeatable; migration/relay/history/coldstart/
+multihost) runs just the named process gate(s), skipping the in-proc
+batching burst — the dev loop for one subsystem.
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -64,6 +75,10 @@ import socket
 import sys
 import tempfile
 import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 N_OPS = 200
 N_COLS = 64
@@ -591,7 +606,224 @@ def coldstart_gate() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
-def main() -> int:
+def multihost_gate() -> dict:
+    """Two host groups under one spec: a subprocess fleet from
+    ``multihost_spec`` (h0 = placement host with the storage tier and
+    table door, h1 in a DISJOINT dir on ``RemoteTableClient``), a
+    driver client writing through a gateway while a FORCED CROSS-HOST
+    migration rips the doc's partition onto the other host — the
+    sealed log ships through storage (``migration.ship`` in the fleet
+    journal), every ack lands exactly once, the remote core's
+    ``placement.table.rpc_reads`` prove the door carried its placement
+    plane, and an ``admin bundle`` of the fleet triages clean through
+    tools/doctor.py with the migration visible."""
+    import shutil
+    import tempfile
+    import threading
+
+    from fluidframework_tpu.driver.network import (
+        NetworkDocumentServiceFactory,
+        _Transport,
+    )
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.service.stage_runner import doc_partition
+    from fluidframework_tpu.service.topology import Fleet, multihost_spec
+
+    work = tempfile.mkdtemp(prefix="net-smoke-mh-")
+    fl = None
+    writer = reader = None
+    try:
+        spec = multihost_spec(os.path.join(work, "fleet"), n_hosts=2,
+                              cores_per_host=1, n_partitions=2,
+                              lease_ttl=1.5)
+        fl = Fleet(spec, subprocess=True).start()
+        fl.wait_claimed()
+
+        k = doc_partition("smoke", "mhdoc", 2)
+        # partitions are pinned round-robin: core k (host h{k}) owns the
+        # doc; the migration target is the OTHER host's core — forcing
+        # the cross-host path (log shipped through storage, not copied
+        # through any shared file)
+        src_port = fl.core_ports[k]
+        dst_core = 1 - k
+        target = f"127.0.0.1:{fl.core_ports[dst_core]}"
+        gw_host, gw_port = fl.gateway_addr(0)
+
+        writer = Loader(NetworkDocumentServiceFactory(
+            gw_host, gw_port), auto_reconnect=True).resolve(
+            "smoke", "mhdoc")
+        sstr = writer.runtime.create_data_store(
+            "default").create_channel("text", "shared-string")
+
+        n_ops = 120
+
+        def feed():
+            for i in range(n_ops):
+                sstr.insert_text(0, f"h{i:03d} ")
+                time.sleep(0.005)
+
+        feeder = threading.Thread(target=feed)
+        feeder.start()
+        try:
+            if not wait_for(lambda: len(sstr.get_text()) >= 60):
+                raise AssertionError("multihost gate: no traffic "
+                                     "before the trigger")
+            t = _Transport("127.0.0.1", src_port, timeout=30.0)
+            try:
+                mig = t.request({"t": "admin_migrate_doc",
+                                 "tenant": "smoke", "doc": "mhdoc",
+                                 "target": target})
+                assert mig["target"] == target, mig
+            finally:
+                t.close()
+        finally:
+            feeder.join()
+        if not wait_for(lambda: writer.connected
+                        and writer.runtime.pending.count == 0,
+                        timeout=60.0):
+            raise AssertionError(
+                f"multihost gate: {writer.runtime.pending.count} op(s) "
+                "still pending after the cross-host flip (acks lost)")
+        reader = Loader(NetworkDocumentServiceFactory(
+            gw_host, gw_port)).resolve("smoke", "mhdoc")
+        if not wait_for(
+                lambda: "text" in reader.runtime.get_data_store(
+                    "default").channels
+                and len(reader.runtime.get_data_store("default")
+                        .get_channel("text").get_text())
+                == len(sstr.get_text())):
+            raise AssertionError(
+                "multihost gate: reader never converged after the "
+                "cross-host flip")
+        text = reader.runtime.get_data_store(
+            "default").get_channel("text").get_text()
+        lost = [i for i in range(n_ops) if text.count(f"h{i:03d} ") != 1]
+        if lost:
+            raise AssertionError(
+                f"multihost gate: {len(lost)} edit(s) lost or "
+                f"duplicated across the flip (first: {lost[:5]})")
+
+        # the remote core's placement plane ran over the wire: its
+        # admin_placement counters must show door round trips
+        remote_core = 1  # core1 is h1's — the non-placement group
+        t = _Transport("127.0.0.1", fl.core_ports[remote_core],
+                       timeout=10.0)
+        try:
+            place = t.request({"t": "admin_placement"})["placement"]
+        finally:
+            t.close()
+        rc = place["counters"]
+        if not rc.get("placement.table.rpc_reads"):
+            raise AssertionError(
+                "multihost gate: the remote core counted zero "
+                "placement.table.rpc_reads — its placement plane did "
+                f"not run through the door ({rc})")
+
+        # the fleet journal must witness the cross-host log ship
+        from fluidframework_tpu.obs.journal import merge_entries
+
+        per_core = []
+        for p in fl.core_ports.values():
+            t = _Transport("127.0.0.1", p, timeout=10.0)
+            try:
+                j = t.request({"t": "admin_journal",
+                               "n": 1000})["journal"]
+                per_core.append(j["entries"])
+            finally:
+                t.close()
+        merged = merge_entries(per_core)
+        ships = [e for e in merged if e["kind"] == "migration.ship"]
+        if not ships:
+            raise AssertionError(
+                "multihost gate: no migration.ship journal entry — "
+                "the cross-host move never shipped the sealed log "
+                "through storage")
+
+        # bundle + doctor triage: the debug surface must capture the
+        # 2-host fleet and the doctor must see the migration
+        import subprocess
+
+        from tools.doctor import diagnose
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        bundle_dir = os.path.join(work, "bundle")
+        out = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.admin",
+             "--port", str(src_port), "bundle", "--out", bundle_dir],
+            capture_output=True, text=True, cwd=repo, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if out.returncode != 0:
+            raise AssertionError(
+                f"multihost gate: admin bundle failed:\n{out.stderr}")
+        rep = diagnose(bundle_dir)
+        if not rep["migrations"]:
+            raise AssertionError(
+                "multihost gate: tools/doctor.py found no migrations "
+                "in the captured bundle")
+        bad = [a for a in rep.get("anomalies", [])
+               if "unreachable host group" in a
+               or "epoch regressed" in a]
+        if bad:
+            raise AssertionError(
+                f"multihost gate: doctor flagged a healthy fleet: {bad}")
+
+        return {
+            "placement.table.rpc_reads": rc.get(
+                "placement.table.rpc_reads", 0),
+            "placement.table.rpc_writes": rc.get(
+                "placement.table.rpc_writes", 0),
+            "obs.journal.migration_ships": len(ships),
+            "doctor.multihost_migrations": len(rep["migrations"]),
+        }
+    finally:
+        for cont in (writer, reader):
+            if cont is not None:
+                try:
+                    cont.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        if fl is not None:
+            fl.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+GATES = {
+    "migration": migration_gate,
+    "relay": relay_gate,
+    "history": history_gate,
+    "coldstart": coldstart_gate,
+    "multihost": multihost_gate,
+}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="socket-tier smoke: batching burst + process gates")
+    ap.add_argument("--only", action="append", choices=sorted(GATES),
+                    metavar="GATE",
+                    help="run ONLY the named gate(s) (repeatable; "
+                         f"one of: {', '.join(sorted(GATES))}) — skips "
+                         "the in-proc batching burst")
+    args = ap.parse_args(argv)
+    if args.only:
+        checks: dict = {}
+        for name in args.only:
+            try:
+                checks.update(GATES[name]())
+            except AssertionError as e:
+                print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+                return 1
+        print(json.dumps({"checks": checks}, indent=2))
+        dead = sorted(k for k, v in checks.items() if v == 0)
+        if dead:
+            print(f"net_smoke: FAIL — counters stayed at zero under "
+                  f"load: {dead}", file=sys.stderr)
+            return 1
+        print("net_smoke: ok")
+        return 0
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from fluidframework_tpu.driver.network import (
         NetworkDocumentServiceFactory,
@@ -922,6 +1154,16 @@ def main() -> int:
     # boots lazily, zero whole-log replays
     try:
         checks.update(coldstart_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+
+    # two host groups under one spec (subprocess fleet, disjoint dirs):
+    # a forced CROSS-HOST migration ships the log through storage, the
+    # remote core's placement plane runs through the table door, and
+    # the bundle triages clean through the doctor
+    try:
+        checks.update(multihost_gate())
     except AssertionError as e:
         print(f"net_smoke: FAIL — {e}", file=sys.stderr)
         return 1
