@@ -480,6 +480,27 @@ def _query_hop_breakdown(port: int) -> dict:
         return {}
 
 
+def _query_probe_p99(port: int) -> dict:
+    """door → p99 ms of the canary's blackbox probes, scraped from the
+    armed core's windowed registry after the knee-rate run: what each
+    real door (connect/submit/history/route) cost END TO END while the
+    core served tenant load."""
+    from fluidframework_tpu.obs import parse_prometheus
+
+    try:
+        frame = _admin_rpc(port, {"t": "admin_metrics_scrape"},
+                           timeout=10.0)
+    except (OSError, ValueError, RuntimeError):
+        return {}
+    series = parse_prometheus(frame.get("scrape", ""))
+    out = {}
+    for key, v in series.get("fluid_health_probe_ms", {}).items():
+        labels = dict(key)
+        if labels.get("quantile") in ("0.99", 0.99):
+            out[labels.get("door", "?")] = round(float(v), 3)
+    return out
+
+
 def bench_network() -> dict:
     """Socket load against a front-end PROCESS: at-load op-ack latency.
 
@@ -731,6 +752,33 @@ def bench_network() -> dict:
                 jfe.terminate()
                 jfe.wait(timeout=10)
 
+        # health-plane A/B at the knee rate: same geometry against two
+        # fresh direct-terminated cores, one with --probe armed (canary
+        # ticker + streaming health engine). The canary is one synthetic
+        # session per tick on a reserved tenant that every admission
+        # seam excludes, so armed steady-state throughput must match
+        # disarmed within noise — the published proof that watching the
+        # doors costs ~nothing. The armed core's registry is scraped
+        # after the run for health.probe.ms p99 per door: the blackbox
+        # door latencies AT LOAD, not on an idle core.
+        health_ab = {}
+        for tag, fe_extra in (
+                ("armed", ("--probe", "--probe-tick", "0.5",
+                           "--health-tick", "0.5")),
+                ("disarmed", ())):
+            hfe, hport = _spawn_listening(
+                "fluidframework_tpu.service.front_end", "--port", "0",
+                *fe_extra)
+            try:
+                health_ab[f"{tag}_ops_per_sec"] = run_workers(
+                    [hport], 4, 64, 2, knee_rate, 32, rounds,
+                    f"hab{tag}")["ops_per_sec"]
+                if tag == "armed":
+                    health_ab["probe_p99_ms"] = _query_probe_p99(hport)
+            finally:
+                hfe.terminate()
+                hfe.wait(timeout=10)
+
         # ---- BASELINE config 4: 1000 docs × 10 clients, 4 gateways.
         # The 10× fan-out geometry has its own (lower) knee: step the
         # per-client rate down until the p99 target holds. If even the
@@ -799,6 +847,7 @@ def bench_network() -> dict:
             "hop_breakdown": hop_breakdown,
             "trace_ab": trace_ab,
             "journal_ab": journal_ab,
+            "health_ab": health_ab,
         }
     finally:
         for gw, _ in gws:
@@ -2658,6 +2707,11 @@ def main() -> None:
                 # rate: the two throughputs must sit within run-to-run
                 # noise of each other
                 "net_trace_ab": net.get("trace_ab", {}),
+                # live health plane armed (canary prober + streaming
+                # engine) vs disarmed at the knee rate: the two
+                # throughputs must sit within run-to-run noise, and the
+                # armed run publishes the canary's per-door p99 at load
+                "net_health_ab": net.get("health_ab", {}),
                 # closed-loop overload control: offered load 0.5×–4× of
                 # the knee against the armed admission gate (capped
                 # "bulk" tenant sheds, uncapped "steady" tenant rides

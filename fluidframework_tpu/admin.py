@@ -316,7 +316,12 @@ def _journal_frame(args) -> dict:
 
 def _fleet_cores(args) -> dict:
     """owner → addr for every registered member (falls back to the
-    queried core alone on an unsharded deployment)."""
+    queried core alone on an unsharded deployment). Every member is
+    captured — an ex-owner's journal is exactly what a migration
+    post-mortem needs — but the BUNDLE marks members holding no
+    partition as unrouted so the doctor's reachability rules skip
+    them (membership rows never expire; a kill -9'd core's stale row
+    must not read as an outage after its parts were re-claimed)."""
     pl = _request(args, {"t": "admin_placement"}).get("placement")
     if pl is None or not pl.get("cores"):
         return {"local": f"{args.host}:{args.port}"}
@@ -394,11 +399,18 @@ def _bundle(args) -> int:
     if pl is not None:
         with open(os.path.join(out, "placement.json"), "w") as f:
             json.dump(pl, f, indent=2, default=str)
+    routed = {p.get("owner")
+              for p in ((pl or {}).get("parts") or {}).values()}
     cores = _fleet_cores(args)
     for owner, addr in cores.items():
         cdir = os.path.join(out, "cores", owner)
         os.makedirs(cdir, exist_ok=True)
         row: dict = {"addr": addr}
+        if pl is not None and owner not in routed:
+            # owns no partition at capture time: journals still matter
+            # (migration chains live on ex-owners), but a failed
+            # capture of a stale membership row is not an outage
+            row["routed"] = False
         manifest["cores"][owner] = row
         try:
             scrape = _peer_request(
@@ -545,6 +557,57 @@ def _history_cmd(args) -> int:
         t.close()
 
 
+def _print_core_health(h: dict, indent: str = "") -> None:
+    comps = h.get("components") or {}
+    doors = ((h.get("probes") or {}).get("doors") or {})
+    armed = h.get("armed", True)
+    print(f"{indent}core {h.get('core') or '?'}: "
+          f"{str(h.get('verdict', 'unknown')).upper()}"
+          + ("" if armed else "  (health plane unarmed)"))
+    for name, c in sorted(comps.items()):
+        state = c.get("state", "?")
+        mark = {"ok": " ", "degraded": "~",
+                "critical": "!"}.get(state, "?")
+        line = f"{indent}  {mark} {name:<10} {state}"
+        if c.get("streak"):
+            line += f"  (streak {c['streak']})"
+        print(line)
+        for reason in c.get("reasons", []):
+            print(f"{indent}      - {reason}")
+    if doors:
+        print(f"{indent}  doors: " + "  ".join(
+            f"{d}={v.get('last_ms', 0):.1f}ms"
+            + ("" if v.get("ok")
+               else f"[FAIL x{v.get('consec_failures')}]")
+            for d, v in sorted(doors.items())))
+    for r in h.get("slo_burn") or []:
+        print(f"{indent}  burn: {r.get('slo')} [{r.get('state')}] "
+              f"p99 {r.get('p99_ms')}ms / {r.get('budget_ms')}ms")
+    for reason in h.get("reasons") or []:
+        # synthetic row for an unreachable peer (no components)
+        print(f"{indent}  - {reason}")
+
+
+def _health_cmd(args) -> int:
+    """`admin health [--fleet]`: the live go/no-go verdict. Exit 0
+    only on OK — CI and the rolling-upgrade loop gate on the code, the
+    way doctor.py gates on a quiet bundle."""
+    frame = {"t": "admin_health"}
+    if args.fleet:
+        frame["fleet"] = 1
+    reply = _request(args, frame)
+    h = reply.get("health") or {}
+    if args.fleet:
+        verdict = str(h.get("verdict", "unknown"))
+        cores = h.get("cores") or {}
+        print(f"fleet: {verdict.upper()}  ({len(cores)} core(s))")
+        for _owner, core_h in sorted(cores.items()):
+            _print_core_health(core_h, indent="  ")
+        return 0 if verdict == "ok" else 1
+    _print_core_health(h)
+    return 0 if h.get("verdict") == "ok" else 1
+
+
 def main(argv=None) -> int:
     # the connection options are accepted before OR after the
     # subcommand (`admin --port P slo` and `admin slo --port P` both
@@ -618,6 +681,15 @@ def main(argv=None) -> int:
     sub.add_parser("slo", parents=[common],
                    help="armed SLO specs: windowed p99 vs "
                         "budget, state, burn progress")
+    s = sub.add_parser("health", parents=[common],
+                       help="live health plane: the streaming "
+                            "doctor's verdict — canary probe doors, "
+                            "per-component states with reasons; exit "
+                            "0 only when OK (the go/no-go gate)")
+    s.add_argument("--fleet", action="store_true",
+                   help="fan out to every core in the epoch table; "
+                        "worst verdict wins and an unreachable core "
+                        "is critical")
     s = sub.add_parser("placement", parents=[common],
                        help="routing plane: epoch table, membership, "
                             "owned partitions, leases, placement.* "
@@ -697,6 +769,8 @@ def main(argv=None) -> int:
                   f"budget {r['budget_ms']}ms [{r['state']}] "
                   f"burn {r['burn']}/{r['burn_ticks']} "
                   f"n={r['count']} window {r['window_s']}s")
+    elif args.cmd == "health":
+        return _health_cmd(args)
     elif args.cmd == "docs":
         reply = _request(args, {"t": "admin_docs"})
         for d in reply["docs"]:

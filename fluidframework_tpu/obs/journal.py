@@ -86,6 +86,8 @@ KINDS = {
     "core.cold_boot": "cold core armed lazy rehydration over its claims",
     "part.rehydrated": "partition served its first lazy doc boot",
     "part.checkpoint_fail": "one doc's checkpoint raised (others kept going)",
+    "health.state": "health engine component transition (ok/degraded/critical)",
+    "health.probe": "canary probe door failed or recovered",
 }
 
 

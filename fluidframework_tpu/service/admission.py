@@ -38,6 +38,7 @@ import time
 from typing import Callable, Optional
 
 from ..obs import get_registry
+from ..obs.probe import CANARY_TENANT
 from ..utils.affinity import loop_only
 
 #: Bounds for the retry_after_ms hint handed to shed clients.
@@ -126,6 +127,12 @@ class AdmissionController:
         ``first_cseq`` on ``conn``. Returns 0.0 to admit, else the
         retry-after in seconds — the caller sheds the WHOLE boxcar."""
         tenant = conn.tenant_id
+        if tenant == CANARY_TENANT:
+            # the canary prober (obs/probe.py) is synthetic blackbox
+            # traffic: it must measure the door, never consume a
+            # tenant's tokens nor be shed by someone else's burn —
+            # defense in depth behind the front end's own skip
+            return 0.0
         resume = getattr(conn, "_shed_resume", None)
         if resume is not None:
             if first_cseq > resume:

@@ -61,6 +61,7 @@ import time
 from typing import Any, Optional
 
 from ..obs import get_journal, get_recorder, get_registry, tier_counters
+from ..obs.probe import CANARY_TENANT
 from ..utils.affinity import loop_only, ticker_thread
 from ..protocol import binwire
 from ..protocol.messages import Nack, NackErrorType, Signal, TraceHop
@@ -106,9 +107,12 @@ def _stamp_abatch(batch, topic=None, tenant=None) -> bytes:
             box.ds_id, box.channel_id, box.kind, box.a, box.b,
             box.cseq, box.rseq, box.text, box.text_off, box.props)
     hops = box.hops
-    if hops:
-        if tenant is None and topic:
-            tenant = topic.partition("/")[0]
+    if hops and tenant is None and topic:
+        tenant = topic.partition("/")[0]
+    if hops and tenant != CANARY_TENANT:
+        # canary hops must not land in the windowed series the SLO
+        # engine burns on: the probe measures the doors and may not
+        # flip the shed machinery those windows gate
         reg = get_registry()
         unknown = count_unknown_hops(hops)
         if unknown:
@@ -501,7 +505,8 @@ class _ClientSession:
                        "admin_tier_snapshot", "admin_rebalance_status",
                        "admin_placement_drain", "admin_migrate_part",
                        "admin_journal", "admin_metrics_history",
-                       "admin_flight_dump", "admin_boot_status"):
+                       "admin_flight_dump", "admin_boot_status",
+                       "admin_health"):
                 self._handle_admin(t, frame, rid)
             elif t == "ping":
                 # client liveness probe on an idle connection (the
@@ -624,8 +629,16 @@ class _ClientSession:
         only: shed traffic is load the partition did NOT carry)."""
         if not ops:
             return ops
-        get_registry().inc("net.ingress.ops", len(ops),
-                           tenant=conn.tenant_id)
+        # synthetic canary traffic (obs/probe.py) rides the real door
+        # but is invisible to the control loops: no ingress accounting,
+        # no tenant bucket charge, no partition heat — probing can
+        # never shed a tenant or trigger a rebalance. The seal bounce
+        # DOES apply: a canary on a migrating partition should observe
+        # exactly what a client would.
+        canary = conn.tenant_id == CANARY_TENANT
+        if not canary:
+            get_registry().inc("net.ingress.ops", len(ops),
+                               tenant=conn.tenant_id)
         if getattr(conn.server, "sealed", False):
             # partition mid-migration: bounce on the shed-retry lane
             # (echoed op + retry_after_ms — the driver parks and
@@ -638,13 +651,14 @@ class _ClientSession:
                 message="partition migrating: resubmit shortly")
             return []
         adm = self.front.admission
-        if adm is not None:
+        if adm is not None and not canary:
             retry_s = adm.check(conn, len(ops),
                                 ops[0].client_sequence_number)
             if retry_s > 0.0:
                 self._push_shed_nacks(ops, retry_s, sid)
                 return []
-        self.front.record_heat(conn.server, len(ops), nbytes)
+        if not canary:
+            self.front.record_heat(conn.server, len(ops), nbytes)
         return ops
 
     def _push_shed_nacks(self, ops: list, retry_s: float, sid,
@@ -693,8 +707,12 @@ class _ClientSession:
             conn = self._fsessions[sid]
         n = len(sc.cseq)
         if n:
-            get_registry().inc("net.ingress.ops", n,
-                               tenant=conn.tenant_id)
+            # canary isolation, columnar door: same seams as
+            # _admit_or_shed (no accounting, no bucket, no heat)
+            canary = conn.tenant_id == CANARY_TENANT
+            if not canary:
+                get_registry().inc("net.ingress.ops", n,
+                                   tenant=conn.tenant_id)
             if getattr(conn.server, "sealed", False):
                 # mid-migration bounce, cold path: materialize the ops
                 # so the shed nacks are byte-identical to the rec door's
@@ -706,7 +724,7 @@ class _ClientSession:
                     message="partition migrating: resubmit shortly")
                 return
             adm = front.admission
-            if adm is not None:
+            if adm is not None and not canary:
                 retry_s = adm.check(conn, n, int(sc.cseq[0]))
                 if retry_s > 0.0:
                     # shed is the cold path: materialize the ops once
@@ -715,7 +733,8 @@ class _ClientSession:
                     self._push_shed_nacks(binwire.cols_to_ops(sc),
                                           retry_s, sid)
                     return
-            front.record_heat(conn.server, n, len(body))
+            if not canary:
+                front.record_heat(conn.server, n, len(body))
         limit = front.max_message_size
         if (getattr(conn, "can_write", True)
                 and 6 * len(body) + 512 <= limit):
@@ -1418,6 +1437,33 @@ class _ClientSession:
                               reason="operator", path=path)
             self.push("admin", {"rid": rid, "path": path,
                                 "journal": dump_id})
+        elif t == "admin_health":
+            # read-only: the streaming doctor's live verdict for this
+            # core (and, with fleet=1, every peer's — worst verdict
+            # wins). An unarmed core answers verdict="unknown" rather
+            # than erroring so a fan-out over a mixed deployment
+            # (some cores without --probe) still completes.
+            engine = front.health_engine
+            if engine is not None:
+                local = dict(engine.status(), armed=True)
+            else:
+                owner = (front.shard_host.owner_id
+                         if front.shard_host is not None else "")
+                local = {"core": owner, "verdict": "unknown",
+                         "components": {}, "armed": False}
+            if not frame.get("fleet"):
+                self.push("admin", {"rid": rid, "health": local})
+                return
+            sh = front.shard_host
+            rec = sh.table.read() if sh is not None else {}
+            # each peer is a synchronous socket dial with a
+            # multi-second timeout: fan out off-loop, push the
+            # aggregate from the done-callback (admin_placement's
+            # --fleet pattern)
+            self._reply_offloop(
+                rid, lambda: front._fleet_health(rec, local),
+                lambda health: self.push(
+                    "admin", {"rid": rid, "health": health}))
 
     def _unsubscribe_ftopic(self, topic: str) -> None:
         entry = self._ftopics.pop(topic, None)
@@ -1809,6 +1855,13 @@ class NetworkFrontEnd:
         # is bound and the shard host registered in the epoch table)
         self.rebalancer = None
         self._rebalance_cfg: Optional[dict] = None
+        # live health plane (--probe): a canary prober walking this
+        # core's own doors + a HealthEngine running the doctor's rules
+        # continuously. Config stored here; both start in _start once
+        # the bound address exists (the canary dials it).
+        self.prober = None
+        self.health_engine = None
+        self._health_cfg: Optional[dict] = None
         # live _ClientSessions (lease-loss teardown walks these)
         self._sessions: set = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -1897,6 +1950,195 @@ class NetworkFrontEnd:
         if self.admin_secret:
             frame["secret"] = self.admin_secret
         admin_rpc(self.host, self.port, frame)
+
+    def enable_health(self, probe_tick_s: float = 2.0,
+                      tick_s: float = 1.0, critical_ticks: int = 3,
+                      probe_fail_critical: int = 3,
+                      probe_timeout: float = 5.0,
+                      max_route_peers: int = 2) -> "NetworkFrontEnd":
+        """Arm the live health plane (--probe): stored as config here;
+        the prober and engine start in ``_start`` once the socket is
+        bound (the canary dials our own listening address) and — on a
+        sharded core — the first poll has claimed partitions, so the
+        canary doc routes."""
+        self._health_cfg = {
+            "probe_tick_s": probe_tick_s, "tick_s": tick_s,
+            "critical_ticks": critical_ticks,
+            "probe_fail_critical": probe_fail_critical,
+            "probe_timeout": probe_timeout,
+            "max_route_peers": max_route_peers}
+        return self
+
+    def _arm_health(self) -> None:
+        """Construct + start the canary prober and the health engine.
+
+        Every engine source closes over LIVE surfaces and returns the
+        bundle-shaped artifact the doctor would read offline — that is
+        what makes the offline/live equivalence test possible. The
+        prober's transport is the driver's ``_Transport`` (a real
+        client dial, not a shortcut into the pipeline: the probe must
+        traverse the same socket, reader thread, and frame codec a
+        user does)."""
+        from ..driver.network import _Transport
+        from ..obs.health import HealthEngine
+        from ..obs.probe import CANARY_DOC, CanaryProber
+
+        cfg = self._health_cfg or {}
+        sh = self.shard_host
+        owner = sh.owner_id if sh is not None else "fe"
+        timeout = cfg.get("probe_timeout", 5.0)
+
+        def dial(host, port, timeout=timeout):
+            return _Transport(host, port, timeout=timeout)
+
+        def doc_fn():
+            # a canary doc routed to THIS core: sharded cores refuse
+            # docs whose partition they don't own, so walk suffixes
+            # until one hashes into our claims (None while we own
+            # nothing — the session doors idle, not fail)
+            if sh is None:
+                return CANARY_DOC
+            from .stage_runner import doc_partition
+
+            owned = set(sh.servers)
+            if not owned:
+                return None
+            for i in range(64):
+                doc = f"{CANARY_DOC}{i}"
+                if doc_partition(CANARY_TENANT, doc, sh.n) in owned:
+                    return doc
+            return None
+
+        def peers_fn():
+            if sh is None:
+                return {}
+            try:
+                rec = sh.table.read()
+            except Exception:  # noqa: BLE001 — table read is advisory
+                return {}
+            # membership is append-only (a capacity advertisement, not
+            # a route): a kill -9'd core's row outlives it forever.
+            # Route-probe only owners a gateway would actually traverse
+            # — those holding ≥1 partition NOW — so a replaced core's
+            # stale row stops counting against fleet health the moment
+            # its partitions are re-claimed.
+            routed = {p.get("owner")
+                      for p in (rec.get("parts") or {}).values()}
+            return {o: {"addr": row.get("addr"),
+                        "host": row.get("host")}
+                    for o, row in (rec.get("cores") or {}).items()
+                    if o in routed}
+
+        def token_fn(tenant, doc):
+            # canary auth: mint against a per-process secret, and
+            # re-assert the registration per mint — the shared-registry
+            # reload on the lease poll replaces the dict and would
+            # silently drop us. On open (dev-mode) deployments we must
+            # NOT register: the first registration flips tenancy to
+            # enforcing and locks every real client out.
+            tm = self.server.tenants
+            if tm is None or not tm.enforcing:
+                return None
+            from .tenants import sign_token
+
+            tm.register(CANARY_TENANT, self._canary_secret)
+            return sign_token(tenant, doc, self._canary_secret)
+
+        import secrets as _secrets
+
+        self._canary_secret = _secrets.token_hex(16)
+        self.prober = CanaryProber(
+            dial, self.host, self.port, core=owner,
+            doc_fn=doc_fn,
+            peers_fn=peers_fn if sh is not None else None,
+            token_fn=token_fn,
+            tick_s=cfg.get("probe_tick_s", 2.0), timeout=timeout,
+            snapshot=True,
+            max_route_peers=cfg.get("max_route_peers", 2)).start()
+
+        def boot_fn():
+            from ..obs import tier_snapshot
+
+            if sh is not None:
+                parts = [s.boot_status()
+                         for _, s in sorted(sh.servers.items())]
+                rehydrator = sh.rehydrator
+            else:
+                parts = [self.server.boot_status()]
+                rehydrator = self.server.rehydrator
+            return {"parts": parts,
+                    "executor": (rehydrator.status()
+                                 if rehydrator is not None else None),
+                    "counters": {k: v for k, v in
+                                 tier_snapshot("frontend").items()
+                                 if k.startswith("boot.part.")}}
+
+        def slo_fn():
+            eng = self.slo_engine
+            return {"slos": eng.status() if eng is not None else []}
+
+        self.health_engine = HealthEngine(
+            core=owner,
+            scrape_fn=get_registry().scrape,
+            journal_fn=lambda: get_journal().tail(n=400),
+            placement_fn=(sh.table.read if sh is not None else None),
+            cores_fn=self.prober.peer_rows,
+            slo_fn=slo_fn,
+            boot_fn=boot_fn,
+            probe_fn=self.prober.status,
+            # a deliberately-unarmed journal (in-process fleets,
+            # bare dev cores) is config, not a failure: report
+            # journal_armed only when it IS armed, so the doctor's
+            # disarmed rule (written for bundles, where a core that
+            # SHOULD journal didn't) stays quiet live
+            self_row_fn=lambda: (
+                {"journal_armed": True} if get_journal().armed else {}),
+            tick_s=cfg.get("tick_s", 1.0),
+            critical_ticks=cfg.get("critical_ticks", 3),
+            probe_fail_critical=cfg.get("probe_fail_critical", 3),
+        ).start()
+
+    def _fleet_health(self, table_rec: dict, local: dict) -> dict:
+        """Fleet verdict: this core's health joined with every peer
+        core's (``admin_health`` fan-out) — worst verdict wins, and an
+        UNREACHABLE peer is critical, not skipped: the go/no-go gate
+        must fail closed, a dead core cannot answer "I'm fine"."""
+        from .placement_plane import admin_rpc
+
+        order = {"ok": 0, "unknown": 1, "degraded": 2, "critical": 3}
+        self_owner = (self.shard_host.owner_id
+                      if self.shard_host is not None else None)
+        cores = {local.get("core") or "": local}
+        worst = local.get("verdict", "unknown")
+        # same routed-owner filter as the prober's peers_fn: membership
+        # rows never expire, so gate only on cores that currently hold
+        # partitions — a kill -9'd core's stale row must not hold the
+        # fleet at critical after its replacement claimed its parts
+        routed = {p.get("owner")
+                  for p in (table_rec.get("parts") or {}).values()}
+        for owner, row in sorted(
+                (table_rec.get("cores") or {}).items()):
+            if owner == self_owner or owner not in routed:
+                continue
+            host_s, _, port_s = row.get("addr", "").rpartition(":")
+            frame = {"t": "admin_health"}
+            if self.admin_secret:
+                frame["secret"] = self.admin_secret
+            try:
+                reply = admin_rpc(host_s or "127.0.0.1", int(port_s),
+                                  frame, timeout=5.0)
+                h = dict(reply.get("health") or {})
+                h.setdefault("core", owner)
+            except (OSError, ValueError, RuntimeError) as e:
+                h = {"core": owner, "verdict": "critical",
+                     "armed": False,
+                     "reasons": [f"core {owner}: admin_health "
+                                 f"unreachable ({e})"]}
+            cores[owner] = h
+            if (order.get(h.get("verdict"), 1)
+                    > order.get(worst, 1)):
+                worst = h.get("verdict")
+        return {"fleet": True, "verdict": worst, "cores": cores}
 
     def _fleet_placement_counters(self, table_rec: dict) -> dict:
         """Fleet-total placement counters: this process's snapshot summed
@@ -2249,6 +2491,10 @@ class NetworkFrontEnd:
                         self.logger.error("lease_poll_error",
                                           message=str(e))
             self._bg_tasks.append(loop.create_task(lease_loop()))
+        if self._health_cfg is not None:
+            # after the first poll: the canary doc must route to a
+            # claimed partition, and peers must see our address
+            self._arm_health()
         self._ready.set()
 
     def start_background(self) -> "NetworkFrontEnd":
@@ -2272,6 +2518,12 @@ class NetworkFrontEnd:
         if self.rebalancer is not None:
             self.rebalancer.stop()
             self.rebalancer = None
+        if self.prober is not None:
+            self.prober.stop()
+            self.prober = None
+        if self.health_engine is not None:
+            self.health_engine.stop()
+            self.health_engine = None
         if self._loop is not None:
             loop = self._loop
 
@@ -2451,6 +2703,23 @@ def main() -> None:
                         default=0.25, metavar="F",
                         help="min hottest→coldest gap as a fraction of "
                              "mean load before a move is worth it")
+    # live health plane (obs/probe.py + obs/health.py): canary probes
+    # through this core's own doors + the doctor's rules evaluated
+    # continuously, served by the admin_health RPC
+    parser.add_argument("--probe", action="store_true",
+                        help="arm the live health plane: a canary "
+                             "prober walking this core's doors on the "
+                             "reserved __canary__ tenant plus the "
+                             "streaming doctor (admin_health)")
+    parser.add_argument("--probe-tick", type=float, default=2.0,
+                        metavar="S", help="canary probe interval")
+    parser.add_argument("--health-tick", type=float, default=1.0,
+                        metavar="S", help="health rule evaluation "
+                                          "interval")
+    parser.add_argument("--health-critical-ticks", type=int, default=3,
+                        metavar="N",
+                        help="consecutive anomalous ticks before a "
+                             "component goes degraded → critical")
     # fleet topology spec (service/topology.py): the whole deployment
     # as one JSON object; every sharded construction path converges on
     # topology.build_core, so a restart from the spec IS the start
@@ -2522,6 +2791,13 @@ def main() -> None:
         if args.max_message_size is not None:
             front.max_message_size = args.max_message_size
         _apply_overload_flags(front, args, parser)
+        if args.probe and front._health_cfg is None:
+            # flag-armed on top of a spec without a health stanza
+            # (spec.health goes through build_core)
+            front.enable_health(
+                probe_tick_s=args.probe_tick,
+                tick_s=args.health_tick,
+                critical_ticks=args.health_critical_ticks)
         front.serve_forever()
         return
     server = None
@@ -2572,6 +2848,10 @@ def main() -> None:
                             max_message_size=args.max_message_size,
                             admin_secret=args.admin_secret)
     _apply_overload_flags(front, args, parser)
+    if args.probe:
+        front.enable_health(probe_tick_s=args.probe_tick,
+                            tick_s=args.health_tick,
+                            critical_ticks=args.health_critical_ticks)
     if args.summarize_every is not None:
         front.enable_summarizer(args.summarize_every)
     for state_dir in args.consume_backchannel:

@@ -208,8 +208,15 @@ class LocalOrderer:
                 # no checkpoint or no acked summary: a joiner would have
                 # nothing to boot from but the ops — replay it all
                 self.boot_mode = "full_replay"
+        from ..obs.probe import CANARY_TENANT
         from .rehydrate import boot_counters
-        if self.boot_mode == "lazy":
+        if tenant_id == CANARY_TENANT:
+            # canary isolation: the synthetic doc is summary-less by
+            # design, so its (tiny) boots must not trip the cold-start
+            # contract (boot.part.full_replay == 0) or the doctor's
+            # boot_anomalies rule on a respawned core
+            pass
+        elif self.boot_mode == "lazy":
             boot_counters().inc("boot.part.lazy")
         elif self.boot_mode == "full_replay":
             boot_counters().inc("boot.part.full_replay")

@@ -97,6 +97,9 @@ class TopologySpec:
     boot_burst: int = 32
     # self-driving placement: kwargs for enable_rebalancer, or None
     rebalance: Optional[dict] = None
+    # live health plane: kwargs for enable_health (canary prober +
+    # streaming doctor on every core), or None = unarmed
+    health: Optional[dict] = None
     # ---- multi-host fleets ----------------------------------------
     # host groups: {host_id: address}. Empty = classic single-host.
     # Each non-placement group runs in a DISJOINT working dir
@@ -328,6 +331,8 @@ def build_core(spec: TopologySpec, core_index: int, *,
         front.enable_summarizer(spec.summarize_every)
     if spec.rebalance is not None:
         front.enable_rebalancer(**spec.rebalance)
+    if spec.health is not None:
+        front.enable_health(**spec.health)
     if spec.boot_rate and spec.boot_rate > 0:
         front.enable_boot_admission(spec.boot_rate, spec.boot_burst)
     boot_counters().inc("topology.core.spawns")
@@ -737,6 +742,64 @@ class Fleet:
             time.sleep(0.05)
         raise TimeoutError(
             f"fleet: partitions unclaimed after {timeout}s")
+
+    def wait_healthy(self, host_id: Optional[str] = None,
+                     timeout: float = 60.0) -> dict:
+        """Block until the probe-backed live health plane answers
+        ``ok`` — the rolling-upgrade go/no-go gate (requires
+        ``spec.health``; an unarmed fleet answers ``unknown`` forever).
+
+        With ``host_id`` only that host group's cores must be healthy
+        (the host just respawned; the rest of the fleet is a later
+        upgrade step); without it every core must be. Generation-gated
+        like :meth:`wait_claimed` (the epoch floor first — a dead
+        generation's core can still answer on a recycled port), and
+        PROBE-backed: a core counts healthy only once a canary has
+        walked its doors successfully this generation, not merely once
+        its engine boots with nothing evaluated yet.
+
+        Returns {core_name: health dict}; raises TimeoutError with the
+        failing verdicts otherwise."""
+        from .placement_plane import admin_rpc
+
+        deadline = time.monotonic() + timeout
+        if host_id is None:
+            targets = sorted(self.core_ports)
+            parts = None
+        else:
+            targets = [i for i in sorted(self.core_ports)
+                       if self.spec.core_host(i) == host_id]
+            parts = {k for i in targets
+                     for k in self.spec.cores[i].prefer} or None
+        self.wait_claimed(
+            timeout=max(0.1, deadline - time.monotonic()), parts=parts)
+        last: dict = {}
+        while time.monotonic() < deadline:
+            verdicts = {}
+            ok = True
+            for i in targets:
+                frame = {"t": "admin_health"}
+                if self.spec.admin_secret:
+                    frame["secret"] = self.spec.admin_secret
+                try:
+                    reply = admin_rpc(*self.core_addr(i), frame,
+                                      timeout=5.0)
+                    h = reply.get("health") or {}
+                except (OSError, ValueError, RuntimeError) as e:
+                    h = {"verdict": "unreachable", "error": str(e)}
+                verdicts[self.spec.core_name(i)] = h
+                doors = ((h.get("probes") or {}).get("doors") or {})
+                probed = any(d.get("probes", 0) and d.get("ok")
+                             for d in doors.values())
+                if h.get("verdict") != "ok" or not probed:
+                    ok = False
+            last = verdicts
+            if ok:
+                return verdicts
+            time.sleep(0.2)
+        summary = {name: h.get("verdict") for name, h in last.items()}
+        raise TimeoutError(
+            f"fleet: not healthy after {timeout}s: {summary}")
 
 
 class _StorageRunner:

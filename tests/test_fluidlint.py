@@ -491,6 +491,29 @@ def test_journal_family_lock_caught(tmp_path):
     assert "obs.journal.entries" in vs[0].message
 
 
+def test_health_family_lock_caught(tmp_path):
+    # the health plane's probe/engine names are an operator contract
+    # (dashboards + Fleet.wait_healthy key on them): the family locks
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('health.probe.errors')\n")  # not a member
+    vs = metrics_check.check_file(path, repo_root=str(tmp_path))
+    assert len(vs) == 1 and 'locked "health.*" family' in vs[0].message, \
+        [str(v) for v in vs]
+    assert "health.probe.failures" in vs[0].message
+
+
+def test_health_family_members_pass(tmp_path):
+    path = _metrics_file(
+        tmp_path,
+        "def f(c):\n"
+        "    c.inc('health.probe.ms')\n"
+        "    c.inc('health.probe.failures')\n"
+        "    c.inc('health.engine.state')\n")
+    assert metrics_check.check_file(path, repo_root=str(tmp_path)) == []
+
+
 # ---------------------------------------------------------- journal-kind
 
 def _journal_tree(tmp_path, mod_src, kinds_src=None):
@@ -556,6 +579,16 @@ def test_journal_nonliteral_kinds_table_caught(tmp_path):
 
 def test_journal_real_tree_clean():
     assert journal_check.check_journal_kinds(repo_root=REPO) == []
+
+
+def test_health_journal_kinds_declared():
+    # the HealthEngine's transition/probe entries are declared in the
+    # real KINDS table (the journal-kind pass enforces emit sites;
+    # this pins the declarations themselves against deletion)
+    kinds = journal_check.load_kinds(REPO)
+    assert "health.state" in kinds
+    assert "health.probe" in kinds
+    assert "flight.dump" in kinds  # the critical-transition evidence
 
 
 # ------------------------------------------------------------------- CLI

@@ -1,0 +1,415 @@
+"""Live health plane: canary probes, the streaming doctor, and the
+fleet health gate.
+
+Three contracts pinned here:
+
+* **Offline/live equivalence** — one incident fixture fed through BOTH
+  consumers of ``tools/doctor_rules.py`` (the offline bundle doctor and
+  the in-process HealthEngine) yields the identical anomaly set. The
+  rules are shared verbatim, so the live verdict and the post-incident
+  verdict can never drift.
+* **Canary isolation** — ``__canary__`` probe traffic walks the REAL
+  doors but never lands in placement heat, tenant token buckets, or the
+  SLO hop windows: probing can never trigger rebalancing or shedding.
+* **The state machine and the gate** — ok→degraded→critical streaks,
+  hard signals, the flight-dump evidence chain, and the probe-backed
+  ``Fleet.wait_healthy`` go/no-go primitive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+from fluidframework_tpu.obs.health import (
+    STATE_CRITICAL,
+    STATE_DEGRADED,
+    STATE_OK,
+    HealthEngine,
+)
+from fluidframework_tpu.obs.journal import (
+    arm_journal,
+    get_journal,
+    read_journal,
+    reset_journal,
+)
+from fluidframework_tpu.obs.metrics import (
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from fluidframework_tpu.obs.probe import CANARY_TENANT
+
+# --------------------------------------------------------------- fixture
+
+
+def _entry(seq, ts, kind, core="core0", epoch=1, **labels):
+    return {"id": f"{core}:{seq}", "seq": seq, "ts": ts, "core": core,
+            "epoch": epoch, "kind": kind, "cause": None,
+            "labels": labels or {}}
+
+
+def _incident_journal():
+    """Storm + cross-host epoch regression + wedged fence + failed
+    migration, in one core's tail."""
+    entries = [_entry(i + 1, 100.0 + i, "rebalance.suppressed")
+               for i in range(10)]
+    entries += [
+        _entry(11, 120.0, "epoch.bump", epoch=5, part="0",
+               change="claim"),
+        _entry(12, 121.0, "epoch.bump", core="core2", epoch=3,
+               part="0", change="claim"),  # later ts, LOWER epoch
+        _entry(13, 150.0, "migration.fence", part="7", final_seq=9),
+        _entry(14, 155.0, "migration.fail", part="9",
+               error="target vanished"),
+        _entry(15, 170.0, "operator.command", command="noop"),
+    ]
+    return entries
+
+
+def _incident_bundle(tmp_path):
+    """A bundle directory with one reachable core and a dead host
+    group, dirty across every rule family the doctor knows."""
+    bundle = tmp_path / "bundle"
+    c0 = bundle / "cores" / "core0"
+    c0.mkdir(parents=True)
+    for owner in ("core2", "core3"):
+        (bundle / "cores" / owner).mkdir()
+    manifest = {"cores": {
+        "core0": {"addr": "127.0.0.1:7000", "journal_armed": True},
+        "core2": {"addr": "10.0.0.2:7000",
+                  "error": "connection refused"},
+        "core3": {"addr": "10.0.0.2:7001", "error": "timed out"},
+    }}
+    (bundle / "manifest.json").write_text(json.dumps(manifest))
+    (bundle / "lint.json").write_text(json.dumps({
+        "clean": False,
+        "violations": [{"pass": "layers", "message": "bad import",
+                        "path": "x.py", "line": 3}]}))
+    placement = {
+        "parts": {"0": {"owner": "ghost", "addr": "10.9.9.9:1",
+                        "epoch": 5}},
+        "cores": {
+            "core0": {"addr": "127.0.0.1:7000", "state": "active",
+                      "host": "h0"},
+            "core2": {"addr": "10.0.0.2:7000", "state": "active",
+                      "host": "h1"},
+            "core3": {"addr": "10.0.0.2:7001", "state": "active",
+                      "host": "h1"},
+        }}
+    (bundle / "placement.json").write_text(json.dumps(placement))
+    scrape = ("fluid_obs_trace_unknown_hops 2\n"
+              "fluid_placement_table_stale_rejections 3\n")
+    (c0 / "scrape.prom").write_text(scrape)
+    journal = _incident_journal()
+    (c0 / "journal.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in journal) + "\n")
+    boot = {"parts": [{"docs_booted": 1, "docs_pending": 4}],
+            "executor": {"parked": 2, "tokens": 3.0},
+            "counters": {"boot.part.full_replay": 1}}
+    (c0 / "boot.json").write_text(json.dumps(boot))
+    slo = {"slos": [{"slo": "interactive", "state": "burning",
+                     "p99_ms": 80.0, "budget_ms": 50.0, "burn": 4,
+                     "burn_ticks": 5}]}
+    (c0 / "slo.json").write_text(json.dumps(slo))
+    return bundle, {"manifest": manifest, "placement": placement,
+                    "scrape": scrape, "journal": journal,
+                    "boot": boot, "slo": slo}
+
+
+# ------------------------------------------------ offline/live equivalence
+
+
+def test_offline_live_equivalence(tmp_path):
+    """The same incident through tools/doctor.py (bundle) and the
+    HealthEngine (live sources) → the identical anomaly multiset and
+    SLO burn rows. This is the whole point of doctor_rules.py: one
+    rule body, two evaluation times."""
+    from tools.doctor import diagnose
+
+    bundle, art = _incident_bundle(tmp_path)
+    report = diagnose(str(bundle))
+
+    eng = HealthEngine(
+        core="core0",
+        scrape_fn=lambda: art["scrape"],
+        journal_fn=lambda: list(art["journal"]),
+        placement_fn=lambda: art["placement"],
+        cores_fn=lambda: dict(art["manifest"]["cores"]),
+        slo_fn=lambda: art["slo"],
+        boot_fn=lambda: art["boot"],
+        lint_fn=lambda: {"clean": False,
+                         "violations": [{"pass": "layers",
+                                         "message": "bad import",
+                                         "path": "x.py", "line": 3}]},
+        self_row_fn=lambda: art["manifest"]["cores"]["core0"],
+        registry=MetricsRegistry(),
+        recorder=SimpleNamespace(dump=lambda *a, **k: "dump"))
+    eng.evaluate()
+
+    assert sorted(eng.anomalies()) == sorted(report["anomalies"])
+    assert report["anomalies"]  # the fixture is dirty, not vacuous
+    assert len(report["anomalies"]) == 13
+    # SLO burn stays out of anomalies in BOTH consumers, same rows
+    assert eng.slo_burn == report["slo_burn"]
+    assert eng.slo_burn[0]["core"] == "core0"
+    # the dead host group is a hard signal: critical on the first tick
+    assert eng.verdict() == "critical"
+    assert eng.status()["components"]["placement"]["state"] == "critical"
+
+
+def test_equivalence_on_healthy_fixture(tmp_path):
+    """A quiet bundle: doctor says healthy, engine says ok — no rule
+    fires in one consumer but not the other."""
+    from tools.doctor import diagnose
+
+    bundle = tmp_path / "bundle"
+    c0 = bundle / "cores" / "core0"
+    c0.mkdir(parents=True)
+    (bundle / "manifest.json").write_text(json.dumps({"cores": {
+        "core0": {"addr": "127.0.0.1:7000", "journal_armed": True}}}))
+    journal = [_entry(1, 100.0, "lease.claim", part="0")]
+    (c0 / "journal.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in journal) + "\n")
+    (c0 / "scrape.prom").write_text("fluid_net_frames_total 5\n")
+    report = diagnose(str(bundle))
+    assert report["anomalies"] == []
+
+    eng = HealthEngine(
+        core="core0",
+        scrape_fn=lambda: "fluid_net_frames_total 5\n",
+        journal_fn=lambda: list(journal),
+        self_row_fn=lambda: {"journal_armed": True},
+        registry=MetricsRegistry())
+    eng.evaluate()
+    assert eng.anomalies() == []
+    assert eng.verdict() == "ok"
+
+
+# --------------------------------------------------------- state machine
+
+
+def _probe_status(failures, error="boom"):
+    return {"doors": {"connect": {
+        "ok": failures == 0, "consec_failures": failures,
+        "probes": 10, "last_ms": 1.0,
+        "last_error": None if failures == 0 else error}}}
+
+
+def test_engine_streak_escalation_and_recovery(tmp_path):
+    """ok → degraded on the first anomalous tick, critical after
+    ``critical_ticks`` consecutive, back to ok on recovery — each
+    transition journaled, the critical one linked to a flight dump."""
+    path = str(tmp_path / "journal" / "c0.jsonl")
+    arm_journal(path, core="c0")
+    try:
+        dumps = []
+
+        def dump(reason, **fields):
+            dumps.append((reason, fields))
+            return f"/flight/{len(dumps)}.jsonl"
+
+        state = {"failures": 0}
+        eng = HealthEngine(
+            core="c0", probe_fn=lambda: _probe_status(state["failures"]),
+            registry=MetricsRegistry(),
+            recorder=SimpleNamespace(dump=dump),
+            critical_ticks=3, probe_fail_critical=99)
+        eng.evaluate()
+        assert eng.verdict() == "ok"
+
+        state["failures"] = 1
+        eng.evaluate()
+        assert eng.status()["components"]["probe"]["state"] == "degraded"
+        state["failures"] = 2
+        eng.evaluate()
+        assert eng.verdict() == "degraded"  # streak 2 < 3
+        state["failures"] = 3
+        eng.evaluate()
+        assert eng.verdict() == "critical"
+        assert dumps and dumps[0][1]["component"] == "probe"
+
+        state["failures"] = 0
+        eng.evaluate()
+        assert eng.verdict() == "ok"
+
+        entries = read_journal(path)
+        trans = [e for e in entries if e["kind"] == "health.state"]
+        assert [(e["labels"]["prev"], e["labels"]["state"])
+                for e in trans] == [("ok", "degraded"),
+                                    ("degraded", "critical"),
+                                    ("critical", "ok")]
+        # the critical transition carries its evidence: cause is the
+        # flight.dump entry journaled right before it
+        crit = trans[1]
+        dump_entries = [e for e in entries if e["kind"] == "flight.dump"]
+        assert len(dump_entries) == 1
+        assert crit["cause"] == dump_entries[0]["id"]
+        assert dump_entries[0]["labels"]["reason"] == "health_critical"
+    finally:
+        reset_journal()
+
+
+def test_engine_hard_probe_signal_skips_streak():
+    """A canary door past ``probe_fail_critical`` consecutive failures
+    is critical IMMEDIATELY — a dead front door does not get to ride
+    out the streak."""
+    eng = HealthEngine(
+        core="c0", probe_fn=lambda: _probe_status(3),
+        registry=MetricsRegistry(),
+        recorder=SimpleNamespace(dump=lambda *a, **k: "d"),
+        critical_ticks=100, probe_fail_critical=3)
+    eng.evaluate()
+    assert eng.verdict() == "critical"
+    reasons = eng.status()["components"]["probe"]["reasons"]
+    assert any("canary probe connect failing (3 consecutive)" in r
+               for r in reasons)
+    assert STATE_OK < STATE_DEGRADED < STATE_CRITICAL
+
+
+def test_engine_unreachable_peer_rows_are_hard():
+    """The prober's peer-reachability rows feed the placement rules:
+    a whole host group of dead peers is the doctor's unreachable-host
+    rule, evaluated live, and it is a hard critical."""
+    placement = {"parts": {}, "cores": {
+        "c0": {"addr": "127.0.0.1:1", "state": "active", "host": "h0"},
+        "c1": {"addr": "10.0.0.2:1", "state": "active", "host": "h1"},
+        "c2": {"addr": "10.0.0.2:2", "state": "active", "host": "h1"},
+    }}
+    rows = {"c1": {"addr": "10.0.0.2:1", "error": "refused"},
+            "c2": {"addr": "10.0.0.2:2", "error": "timeout"}}
+    eng = HealthEngine(
+        core="c0", placement_fn=lambda: placement,
+        cores_fn=lambda: rows, registry=MetricsRegistry(),
+        recorder=SimpleNamespace(dump=lambda *a, **k: "d"),
+        critical_ticks=100)
+    eng.evaluate()
+    assert eng.verdict() == "critical"
+    assert any("host group h1" in r for r in eng.anomalies())
+
+
+# ------------------------------------------------------- canary isolation
+
+
+def test_admission_never_charges_canary():
+    """The canary prober submits through the real admission gate but
+    never consumes a token nor gets shed — even with a zero-rate
+    bucket configured for it and the shed signal active."""
+    from fluidframework_tpu.service.admission import AdmissionController
+
+    adm = AdmissionController(lambda t: (0.001, 1.0),
+                              registry=MetricsRegistry())
+    adm.engine = SimpleNamespace(shed_signal="violated")
+    conn = SimpleNamespace(tenant_id=CANARY_TENANT)
+    for cseq in (1, 2, 3):
+        assert adm.check(conn, 100, cseq, now=0.0) == 0.0
+    assert CANARY_TENANT not in adm._buckets
+    # a real tenant on the same controller IS shed (the gate works)
+    real = SimpleNamespace(tenant_id="acme")
+    assert adm.check(real, 100, 1, now=0.0) == 0.0  # burst admits
+    assert adm.check(real, 100, 2, now=0.0) > 0.0
+
+
+def test_stamp_abatch_skips_canary_hops(monkeypatch):
+    """The egress hop observe — the SLO engine's read source — skips
+    canary boxcars: probe latency may never burn a tenant SLO."""
+    import fluidframework_tpu.service.front_end as fe
+    from fluidframework_tpu.utils.telemetry import HOP_ADMIT, HOP_SUBMIT
+
+    monkeypatch.setattr(fe.binwire, "stamp_cols_ops",
+                        lambda *a, **k: b"")
+    reset_registry()
+    try:
+        reg = get_registry()
+
+        def batch(topic):
+            box = SimpleNamespace(
+                wire_cols=b"\x00", client_id="c",
+                hops=[(HOP_SUBMIT, 1.0), (HOP_ADMIT, 1.002)])
+            return SimpleNamespace(boxcar=box, base_seq=1, msns=None,
+                                   timestamp=0.0), topic
+
+        fe._stamp_abatch(*batch(f"{CANARY_TENANT}/__probe__0"))
+        assert reg.window_sum("obs.hop.window_ms") == 0.0
+        fe._stamp_abatch(*batch("acme/doc"))
+        assert reg.window_sum("obs.hop.window_ms",
+                              tenant="acme") > 0.0
+        assert reg.window_sum("obs.hop.window_ms",
+                              tenant=CANARY_TENANT) == 0.0
+    finally:
+        reset_registry()
+
+
+# ------------------------------------------------- the fleet health gate
+
+
+def test_fleet_wait_healthy_probe_backed_and_isolated(tmp_path):
+    """End to end on an in-process fleet with the health plane armed:
+    ``wait_healthy`` returns only after canaries have walked every
+    door, the fleet ``admin_health`` verdict aggregates to ok, and the
+    canary's synthetic traffic left ZERO trace in placement heat, hop
+    windows, tenant buckets, or anywhere else in the scrape."""
+    from fluidframework_tpu.service.placement_plane import admin_rpc
+    from fluidframework_tpu.service.rebalancer import HEAT_OPS
+    from fluidframework_tpu.service.topology import Fleet, default_spec
+
+    reset_registry()
+    spec = default_spec(str(tmp_path / "fleet"), n_cores=2,
+                        n_partitions=4, lease_ttl=2.0,
+                        health={"probe_tick_s": 0.2, "tick_s": 0.2})
+    fl = Fleet(spec).start()
+    try:
+        fl.wait_claimed()
+        verdicts = fl.wait_healthy(timeout=30.0)
+        assert sorted(verdicts) == ["core0", "core1"]
+        for h in verdicts.values():
+            assert h["verdict"] == "ok"
+            doors = h["probes"]["doors"]
+            # every session door probed ok; two cores → route too
+            for door in ("connect", "submit", "history", "route"):
+                assert doors[door]["probes"] > 0
+                assert doors[door]["ok"], doors[door]
+
+        reply = admin_rpc(*fl.core_addr(0),
+                          {"t": "admin_health", "fleet": 1},
+                          timeout=15.0)
+        fleet_h = reply["health"]
+        assert fleet_h["fleet"] is True
+        assert fleet_h["verdict"] == "ok"
+        assert len(fleet_h["cores"]) == 2
+
+        # ---- isolation: the probes ran, yet the canary is invisible
+        reg = get_registry()
+        assert reg.window_sum(HEAT_OPS) == 0.0  # no rebalancer input
+        assert reg.window_sum("obs.hop.window_ms",
+                              tenant=CANARY_TENANT) == 0.0
+        assert CANARY_TENANT not in reg.scrape()
+        for front in fl.fronts.values():
+            adm = front.admission
+            assert adm is None or CANARY_TENANT not in adm._buckets
+        # but the probe's OWN metrics did land (it measures, after all)
+        assert reg.window_sum("health.probe.ms", door="connect") > 0.0
+    finally:
+        fl.stop()
+        reset_registry()
+
+
+def test_wait_healthy_times_out_on_unarmed_fleet(tmp_path):
+    """A fleet without ``spec.health`` answers ``unknown`` — the gate
+    must refuse to pass it (fail closed), not vacuously succeed."""
+    import pytest
+
+    from fluidframework_tpu.service.topology import Fleet, default_spec
+
+    spec = default_spec(str(tmp_path / "fleet"), n_cores=1,
+                        n_partitions=2, lease_ttl=2.0)
+    fl = Fleet(spec).start()
+    try:
+        fl.wait_claimed()
+        with pytest.raises(TimeoutError) as ei:
+            fl.wait_healthy(timeout=1.5)
+        assert "unknown" in str(ei.value)
+    finally:
+        fl.stop()

@@ -31,7 +31,9 @@ The doctor joins these into a triage report:
    admission bucket — the storm stalled), static-contract
    violations in the capturing build (a dirty ``lint.json`` in
    production is an incident signal of its own — someone deployed past
-   the gate), and the multi-host trio: an UNREACHABLE HOST GROUP
+   the gate), wedged migrations (a ``migration.fence`` with no
+   commit/fail while the journal kept moving — the partition sealed
+   with nobody coming to adopt it), and the multi-host trio: an UNREACHABLE HOST GROUP
    (every core a host id advertises failed capture — a machine down,
    not a core restarting), a CROSS-HOST EPOCH REGRESSION (a later
    ``epoch.bump`` with a lower epoch for the same partition — two
@@ -43,6 +45,11 @@ The doctor joins these into a triage report:
 Read-only; exit 0 with "healthy" when nothing needs attention, exit 1
 when any anomaly or active SLO burn was found (so a CI gate can assert
 a bundle is quiet — or assert it ISN'T after a forced incident).
+
+The anomaly rules themselves live in ``tools/doctor_rules.py``, shared
+verbatim with the in-process streaming doctor
+(``fluidframework_tpu/obs/health.py``) — the live verdict and the
+post-incident bundle verdict run the SAME code, never a re-derivation.
 """
 
 from __future__ import annotations
@@ -60,15 +67,16 @@ from fluidframework_tpu.obs.journal import (  # noqa: E402
     causal_chain,
     merge_entries,
 )
+from tools import doctor_rules as rules  # noqa: E402
+from tools.doctor_rules import (  # noqa: E402,F401  (re-exported names)
+    STORM_THRESHOLD,
+    scrape_counter as _scrape_counter,
+)
 
 #: scrape lines for the hop summaries: fluid_obs_hop_ms_count{...} N
 _SCRAPE_RE = re.compile(
     r'^fluid_obs_hop_ms_(count|sum)\{([^}]*)\}\s+([0-9.eE+-]+)')
 _LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
-
-#: consecutive rebalance.suppressed entries (no plan between) that
-#: count as a storm — the loop wants to move but can't
-STORM_THRESHOLD = 10
 
 
 def _load_json(path):
@@ -117,17 +125,6 @@ def _hop_table(scrape_text: str) -> dict:
     return acc
 
 
-def _scrape_counter(scrape_text: str, name: str) -> float:
-    total = 0.0
-    pat = re.compile(r"^" + re.escape(name) + r'(?:\{[^}]*\})?\s+'
-                     r"([0-9.eE+-]+)")
-    for line in scrape_text.splitlines():
-        m = pat.match(line)
-        if m is not None:
-            total += float(m.group(1))
-    return total
-
-
 def _fmt_entry(e: dict) -> str:
     labels = " ".join(f"{k}={v}" for k, v in
                       sorted((e.get("labels") or {}).items()))
@@ -148,11 +145,7 @@ def diagnose(bundle_dir: str) -> dict:
     # a dirty tree in production is itself an incident signal
     lint = _load_json(os.path.join(bundle_dir, "lint.json"))
     report["lint"] = lint
-    if lint is not None and not lint.get("clean", True):
-        for v in lint.get("violations", []):
-            anomalies.append(
-                f"lint [{v.get('pass')}]: {v.get('message')} "
-                f"({v.get('path')}:{v.get('line')})")
+    anomalies.extend(rules.lint_anomalies(lint))
     placement = _load_json(os.path.join(bundle_dir, "placement.json"))
     cores_dir = os.path.join(bundle_dir, "cores")
     owners = (sorted(os.listdir(cores_dir))
@@ -164,10 +157,7 @@ def diagnose(bundle_dir: str) -> dict:
         cdir = os.path.join(cores_dir, owner)
         row = dict(manifest.get("cores", {}).get(owner, {}))
         report["cores"][owner] = row
-        if row.get("error"):
-            anomalies.append(
-                f"core {owner}: capture error ({row['error']}) — "
-                "unreachable or mid-restart at bundle time")
+        anomalies.extend(rules.capture_error_anomalies(owner, row))
         scrape_path = os.path.join(cdir, "scrape.prom")
         try:
             with open(scrape_path) as f:
@@ -177,34 +167,16 @@ def diagnose(bundle_dir: str) -> dict:
         for pair, (count, total) in _hop_table(scrape).items():
             c, t = hop_acc.get(pair, (0.0, 0.0))
             hop_acc[pair] = (c + count, t + total)
-        unknown = _scrape_counter(scrape, "fluid_obs_trace_unknown_hops")
-        if unknown:
-            anomalies.append(
-                f"core {owner}: {int(unknown)} hop stamp(s) outside "
-                "this build's taxonomy (version-skewed client?) — "
-                "the breakdown is missing legs")
-        rejected = _scrape_counter(
-            scrape, "fluid_placement_table_stale_rejections")
-        if rejected:
-            anomalies.append(
-                f"core {owner}: {int(rejected)} remote-table write(s) "
-                "rejected by the door's fence — a zombie ex-owner kept "
-                "writing the epoch table after takeover (the fence held, "
-                "but that core's lease view is stale: check its host "
-                "group's clock and network)")
+        anomalies.extend(rules.scrape_anomalies(owner, scrape))
         journal = _load_journal(os.path.join(cdir, "journal.jsonl"))
         per_core_journals.append(journal)
-        if row.get("journal_armed") is False and not journal:
-            anomalies.append(
-                f"core {owner}: journal disarmed — no audit trail "
-                "from this core")
+        anomalies.extend(
+            rules.journal_disarmed_anomalies(owner, row, journal))
         err = sum(1 for e in journal if e.get("kind") == "core.recover")
         if err:
             row["recoveries"] = err
         slo = _load_json(os.path.join(cdir, "slo.json")) or {}
-        for r in slo.get("slos", []):
-            if r.get("state") != "ok":
-                report["slo_burn"].append({"core": owner, **r})
+        report["slo_burn"].extend(rules.slo_burn_rows(owner, slo))
         # cold-start surface: rehydration progress at capture time
         boot = _load_json(os.path.join(cdir, "boot.json"))
         if boot is not None:
@@ -215,38 +187,9 @@ def diagnose(bundle_dir: str) -> dict:
                           for p in boot.get("parts", []))
             row["boot"] = {"booted": booted, "pending": pending,
                            "parked": ex.get("parked", 0)}
-            replays = (boot.get("counters") or {}).get(
-                "boot.part.full_replay", 0)
-            if replays:
-                anomalies.append(
-                    f"core {owner}: {replays} doc boot(s) paid a "
-                    "WHOLE-LOG replay — a summary or checkpoint is "
-                    "missing, so the cold-start bound is gone for "
-                    "those docs")
-            if (pending and ex.get("parked", 0)
-                    and ex.get("tokens", 0) >= 1):
-                anomalies.append(
-                    f"core {owner}: {pending} doc(s) still pending "
-                    f"with {ex['parked']} boot(s) parked against a "
-                    "refilled admission bucket — the storm stalled "
-                    "(clients gave up retrying, or first routes never "
-                    "arrived)")
-        # suppression storm: longest run of rebalance.suppressed
-        # without an actionable plan breaking it
-        run = best = 0
-        for e in journal:
-            kind = e.get("kind", "")
-            if kind == "rebalance.suppressed":
-                run += 1
-                best = max(best, run)
-            elif kind == "rebalance.plan":
-                run = 0
-        if best >= STORM_THRESHOLD:
-            anomalies.append(
-                f"core {owner}: rebalance suppression storm ({best} "
-                "consecutive suppressed ticks) — the loop wants to "
-                "move but hysteresis/budget keeps refusing; check "
-                "dwell/budget settings vs the heat imbalance")
+            anomalies.extend(rules.boot_anomalies(owner, boot))
+        anomalies.extend(
+            rules.suppression_storm_anomalies(owner, journal))
 
     report["hop_pairs"] = sorted(
         ((pair, count, total / count if count else 0.0, total)
@@ -255,72 +198,21 @@ def diagnose(bundle_dir: str) -> dict:
 
     merged = merge_entries(per_core_journals)
     report["journal_merged"] = merged
-    # cross-host epoch regression: replayed in WALL-CLOCK order, each
-    # partition's epoch.bump sequence must only move forward — a later
-    # bump with a lower epoch means two cores wrote the table through
-    # different planes (a host group split-brained past the fence)
-    last_bump: dict = {}
-    for e in sorted((e for e in merged if e.get("kind") == "epoch.bump"),
-                    key=lambda e: (e.get("ts", 0.0), e.get("epoch", 0))):
-        part = (e.get("labels") or {}).get("part")
-        epoch = e.get("epoch")
-        if part is None or epoch is None:
-            continue
-        prev = last_bump.get(part)
-        if prev is not None and epoch < prev[0]:
-            anomalies.append(
-                f"part {part}: epoch regressed e{epoch} on "
-                f"{e.get('core')} after e{prev[0]} on {prev[1]} — two "
-                "cores wrote the epoch table through different planes "
-                "(a remote group bypassing the table door?)")
-        if prev is None or epoch > prev[0]:
-            last_bump[part] = (epoch, e.get("core"))
+    # cross-host epoch regressions, then wedged migrations (a fence
+    # with no commit/fail while the journal kept moving) — both over
+    # the wall-clock-merged fleet journal
+    anomalies.extend(rules.epoch_regression_anomalies(merged))
+    anomalies.extend(rules.fence_without_commit_anomalies(merged))
     for e in merged:
         if e.get("kind") in ("migration.commit", "migration.fail"):
             report["migrations"].append(
                 {"entry": e, "chain": causal_chain(merged, e["id"])})
             if e["kind"] == "migration.fail":
-                anomalies.append(
-                    f"migration of part "
-                    f"{(e.get('labels') or {}).get('part')} FAILED on "
-                    f"{e.get('core')}: "
-                    f"{(e.get('labels') or {}).get('error')}")
+                anomalies.append(rules.migration_fail_anomaly(e))
     report["migrations"] = report["migrations"][-5:]
 
-    if placement is not None:
-        member_states = {owner: row.get("state")
-                         for owner, row in
-                         (placement.get("cores") or {}).items()}
-        owned_by: dict = {}
-        for k, part in (placement.get("parts") or {}).items():
-            owned_by.setdefault(part.get("owner"), []).append(k)
-            if member_states and part.get("owner") not in member_states:
-                anomalies.append(
-                    f"part {k}: owner {part.get('owner')} is not in "
-                    "the core membership — orphaned routing entry "
-                    "(stale lease / dead core?)")
-        for owner, state in member_states.items():
-            if state in ("draining", "drained") and owned_by.get(owner):
-                anomalies.append(
-                    f"core {owner} is {state} but still owns parts "
-                    f"{sorted(owned_by[owner])} — evacuation stuck?")
-        # unreachable host group: every core a host id advertises in the
-        # membership failed capture — that is a machine (or its network)
-        # down, not a core restarting; triage the host first
-        by_host: dict = {}
-        for owner, row in (placement.get("cores") or {}).items():
-            host = row.get("host")
-            if host is not None:
-                by_host.setdefault(host, []).append(owner)
-        for host, members in sorted(by_host.items()):
-            captured = [o for o in members if o in report["cores"]]
-            if captured and all(report["cores"][o].get("error")
-                                for o in captured):
-                anomalies.append(
-                    f"host group {host}: all {len(captured)} core(s) "
-                    f"({', '.join(sorted(captured))}) unreachable at "
-                    "capture — the whole host group is down or "
-                    "partitioned from the entry core")
+    anomalies.extend(
+        rules.placement_anomalies(placement, report["cores"]))
     return report
 
 

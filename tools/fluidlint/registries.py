@@ -99,6 +99,13 @@ def load_hops(repo_root: Optional[str] = None) -> Optional[tuple]:
 #: is not in the set is either a typo or an unreviewed contract change.
 LOCKED_FAMILIES = {
     "obs.slo.": frozenset({"obs.slo.state", "obs.slo.violations"}),
+    # the live health plane (obs/probe.py + obs/health.py): the
+    # net-smoke health gate, `admin health --fleet`, and the rolling-
+    # upgrade wait_healthy primitive all key on these exact names —
+    # probe.ms{door} is the canary's per-door latency window,
+    # engine.state is the per-component ok/degraded/critical gauge
+    "health.": frozenset({"health.probe.ms", "health.probe.failures",
+                          "health.engine.state"}),
     "net.admission.": frozenset({"net.admission.shed",
                                  "net.admission.delayed"}),
     # the snapshot fast-boot plane: the net-smoke catch-up gate, the

@@ -56,11 +56,18 @@ amortization points of the socket tier (see ARCHITECTURE.md
   storage (``migration.ship`` in the fleet journal), every ack must
   land exactly once, the remote core's ``placement.table.rpc_reads``
   must be nonzero (its placement plane ran through the door), and an
-  ``admin bundle`` must triage clean through tools/doctor.py.
+  ``admin bundle`` must triage clean through tools/doctor.py;
+- the live health plane (canary probes + streaming doctor + fleet
+  gate): a 2-host fleet with probing armed, one host group killed -9
+  mid-probe — the survivor's engine must reach ``critical`` with a
+  reason NAMING the dead peer, a bundle captured during the outage
+  must make tools/doctor.py agree with the live verdict, and after
+  the respawn ``Fleet.wait_healthy`` (the rolling-upgrade go/no-go
+  gate) must reopen with the doctor quiet again.
 
 ``--only GATE`` (repeatable; migration/relay/history/coldstart/
-multihost) runs just the named process gate(s), skipping the in-proc
-batching burst — the dev loop for one subsystem.
+multihost/health) runs just the named process gate(s), skipping the
+in-proc batching burst — the dev loop for one subsystem.
 
 Exit 1 names every counter that stayed at zero: a refactor that
 silently disengages the batching fails the commit gate, not the next
@@ -788,12 +795,146 @@ def multihost_gate() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def health_gate() -> dict:
+    """The live health plane under a real outage: a 2-host subprocess
+    fleet with canary probing armed, one host group killed -9 mid-probe.
+    The survivor's HealthEngine must reach ``critical`` with a reason
+    NAMING the dead peer (the canary route door saw it first), the
+    fleet ``admin_health`` verdict must aggregate to critical (the
+    unreachable core fails closed), an ``admin bundle`` captured during
+    the outage must make tools/doctor.py agree with the live verdict —
+    and after the host respawns, ``Fleet.wait_healthy`` (the
+    rolling-upgrade go/no-go gate) must reopen, with a fresh bundle
+    triaging quiet on the outage rules."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from fluidframework_tpu.service.placement_plane import admin_rpc
+    from fluidframework_tpu.service.topology import Fleet, multihost_spec
+    from tools.doctor import diagnose
+
+    work = tempfile.mkdtemp(prefix="net-smoke-health-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fl = None
+    try:
+        # lease_ttl is deliberately LONG: the dead core must still be
+        # in the placement membership when the bundle captures it, so
+        # the doctor sees the same outage the live engine does
+        spec = multihost_spec(
+            os.path.join(work, "fleet"), n_hosts=2, cores_per_host=1,
+            n_partitions=2, gateway_per_host=False, lease_ttl=8.0,
+            health={"probe_tick_s": 0.25, "tick_s": 0.25,
+                    "critical_ticks": 2, "probe_fail_critical": 2,
+                    "probe_timeout": 2.0})
+        fl = Fleet(spec, subprocess=True).start()
+        fl.wait_claimed()
+        verdicts = fl.wait_healthy(timeout=60.0)
+        if sorted(verdicts) != ["core0", "core1"]:
+            raise AssertionError(
+                f"health gate: wait_healthy returned {sorted(verdicts)}")
+        doors_ok = sum(
+            1 for h in verdicts.values()
+            for d in (h["probes"]["doors"] or {}).values()
+            if d.get("ok") and d.get("probes"))
+
+        def live_health(fleet=False):
+            frame = {"t": "admin_health"}
+            if fleet:
+                frame["fleet"] = 1
+            return admin_rpc(*fl.core_addr(0), frame,
+                             timeout=10.0)["health"]
+
+        dead_addr = f"127.0.0.1:{fl.core_ports[1]}"
+        fl.kill_host("h1")
+
+        # the survivor's canary route door fails consecutively → the
+        # hard probe signal flips the engine critical within ~1s
+        if not wait_for(lambda: live_health()["verdict"] == "critical",
+                        timeout=30.0):
+            raise AssertionError(
+                "health gate: survivor engine never reached critical "
+                f"after the host kill (verdict: "
+                f"{live_health()['verdict']})")
+        h = live_health()
+        reasons = [r for c in h["components"].values()
+                   for r in c["reasons"]]
+        named = [r for r in reasons if dead_addr in r]
+        if not named:
+            raise AssertionError(
+                "health gate: no critical reason names the dead peer "
+                f"{dead_addr} (got {reasons})")
+        fleet_h = live_health(fleet=True)
+        if fleet_h["verdict"] != "critical":
+            raise AssertionError(
+                "health gate: fleet verdict did not fail closed on the "
+                f"unreachable core (got {fleet_h['verdict']})")
+
+        # bundle → doctor must AGREE with the live verdict: the dead
+        # host group is an anomaly offline too
+        bundle_out = os.path.join(work, "bundle-outage")
+        out = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.admin",
+             "--port", str(fl.core_ports[0]), "bundle",
+             "--out", bundle_out],
+            capture_output=True, text=True, cwd=repo, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if out.returncode != 0:
+            raise AssertionError(
+                f"health gate: admin bundle failed:\n{out.stderr}")
+        rep = diagnose(bundle_out)
+        outage = [a for a in rep["anomalies"]
+                  if "capture error" in a or "host group h1" in a]
+        if not outage:
+            raise AssertionError(
+                "health gate: live verdict is critical but the doctor "
+                "found no outage in the bundle — the offline and live "
+                f"rules disagree (anomalies: {rep['anomalies']})")
+
+        # respawn: the go/no-go gate must reopen on the SAME primitive
+        # the rolling-upgrade loop will use
+        fl.start_host("h1")
+        recovered = fl.wait_healthy(timeout=60.0)
+        if any(h["verdict"] != "ok" for h in recovered.values()):
+            raise AssertionError(
+                "health gate: fleet never recovered after the respawn "
+                f"({ {k: v['verdict'] for k, v in recovered.items()} })")
+        bundle_rec = os.path.join(work, "bundle-recovered")
+        out = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.admin",
+             "--port", str(fl.core_ports[0]), "bundle",
+             "--out", bundle_rec],
+            capture_output=True, text=True, cwd=repo, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if out.returncode != 0:
+            raise AssertionError(
+                f"health gate: post-recovery bundle failed:\n{out.stderr}")
+        rep2 = diagnose(bundle_rec)
+        stale = [a for a in rep2["anomalies"]
+                 if "capture error" in a or "host group" in a]
+        if stale:
+            raise AssertionError(
+                "health gate: doctor still flags the outage after "
+                f"recovery (live verdict is ok): {stale}")
+        return {
+            "health.gate.doors_probed_ok": doors_ok,
+            "health.gate.critical_reasons": len(named),
+            "health.gate.doctor_outage_anomalies": len(outage),
+            "health.gate.recovered_cores": len(recovered),
+        }
+    finally:
+        if fl is not None:
+            fl.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 GATES = {
     "migration": migration_gate,
     "relay": relay_gate,
     "history": history_gate,
     "coldstart": coldstart_gate,
     "multihost": multihost_gate,
+    "health": health_gate,
 }
 
 
@@ -1164,6 +1305,15 @@ def main(argv=None) -> int:
     # the bundle triages clean through the doctor
     try:
         checks.update(multihost_gate())
+    except AssertionError as e:
+        print(f"net_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+
+    # the live health plane: canary probes, the streaming doctor's
+    # critical verdict on a killed host group, the bundle→doctor
+    # agreement, and the wait_healthy gate reopening on respawn
+    try:
+        checks.update(health_gate())
     except AssertionError as e:
         print(f"net_smoke: FAIL — {e}", file=sys.stderr)
         return 1
